@@ -1,0 +1,23 @@
+"""federated_pytorch_test_tpu — a TPU-native federated-learning framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of
+SarodYatawatta/federated-pytorch-test (reference mounted at /root/reference):
+train K models on disjoint 1/K data shards, exchanging only a *subset* of
+parameters per round (blockwise federation) under FedAvg / FedProx / adaptive-rho
+ADMM consensus, plus federated VAE, clustering-VAE and CPC workloads, and a
+stochastic L-BFGS optimizer.
+
+Design (see /root/repo/SURVEY.md section 7):
+  * the K clients live on a ``jax.sharding.Mesh`` axis ``'clients'`` instead of a
+    sequential Python loop (reference: federated_multi.py:168);
+  * blockwise freezing (reference: simple_utils.py:34-45) becomes static boolean
+    leaf-masks over the parameter pytree;
+  * parameter averaging (reference: federated_multi.py:208-211) becomes
+    ``lax.pmean``/``lax.psum`` collectives over ICI;
+  * the stochastic L-BFGS (reference: lbfgsnew.py) becomes a jit-compatible
+    solver on flat masked parameter vectors.
+"""
+
+__version__ = "0.1.0"
+
+from federated_pytorch_test_tpu.utils import tree as tree_utils  # noqa: F401

@@ -1,0 +1,101 @@
+"""CPC models for LOFAR visibility patches (arXiv:1905.09272).
+
+Re-designs of reference simple_models.py:436-514:
+  * ``EncoderCNN``    — 8-channel input (4 pol x re/im), 5 parallel dilated
+    convs (dilation 1,2,4,8,16) concatenated, then 3 strided convs to
+    ``latent_dim``, avg-pool (reference :436-470);
+  * ``ContextgenCNN`` — pixelCNN-ish 4-conv latents→context, shape preserving,
+    bias-free (reference :474-494);
+  * ``PredictorCNN``  — two 1x1 convs projecting latents and context to
+    ``reduced_dim`` for InfoNCE (reference :498-514).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from federated_pytorch_test_tpu.models.base import BlockModule, elu, pairs
+
+
+def _pad(p: int):
+    return ((p, p), (p, p))
+
+
+class EncoderCNN(BlockModule):
+    latent_dim: int = 1024
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        """x: [B, 32, 32, 8] → [B, latent_dim]."""
+        # five dilated views, all 32x32 -> 16x16
+        xs = []
+        for d, p in ((1, 1), (2, 3), (4, 6), (8, 12), (16, 24)):
+            xs.append(elu(nn.Conv(8, (4, 4), strides=(2, 2), kernel_dilation=(d, d),
+                                  padding=_pad(p), name=f"conv1_{d}")(x)))
+        x = jnp.concatenate(xs, axis=-1)  # [B,16,16,40]
+        x = elu(nn.Conv(self.latent_dim // 4, (4, 4), strides=(2, 2),
+                        padding=_pad(1), name="conv2")(x))  # 8x8
+        x = elu(nn.Conv(self.latent_dim // 2, (4, 4), strides=(2, 2),
+                        padding=_pad(1), name="conv3")(x))  # 4x4
+        x = elu(nn.Conv(self.latent_dim, (4, 4), strides=(2, 2),
+                        padding=_pad(1), name="conv4")(x))  # 2x2
+        x = nn.avg_pool(x, window_shape=(2, 2), strides=(2, 2))  # 1x1
+        return x.reshape((x.shape[0], -1))  # [B, latent_dim]
+
+    def param_order(self) -> List[str]:
+        return pairs("conv1_1", "conv1_2", "conv1_4", "conv1_8", "conv1_16",
+                     "conv2", "conv3", "conv4")
+
+    def train_order_block_ids(self) -> List[List[int]]:
+        # reference simple_models.py:468-470
+        return [[0, 9], [10, 15]]
+
+
+class ContextgenCNN(BlockModule):
+    latent_dim: int = 1024
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        """x: [B, px, py, latent_dim] → same shape."""
+        x = elu(nn.Conv(self.latent_dim // 4, (1, 1), use_bias=False,
+                        padding="VALID", name="conv1")(x))
+        x = elu(nn.Conv(self.latent_dim // 4, (2, 2), use_bias=False,
+                        padding=_pad(1), name="conv2")(x))  # px+1
+        x = elu(nn.Conv(self.latent_dim // 2, (2, 2), use_bias=False,
+                        padding="VALID", name="conv3")(x))  # px
+        x = elu(nn.Conv(self.latent_dim, (1, 1), use_bias=False,
+                        padding="VALID", name="conv4")(x))
+        return x
+
+    def param_order(self) -> List[str]:
+        # bias-free convs: one flat entry per conv (matches torch enumeration)
+        return ["conv1/kernel", "conv2/kernel", "conv3/kernel", "conv4/kernel"]
+
+    def train_order_block_ids(self) -> List[List[int]]:
+        # reference simple_models.py:492-494 — full net
+        return [[0, 3]]
+
+
+class PredictorCNN(BlockModule):
+    latent_dim: int = 1024
+    reduced_dim: int = 64
+
+    @nn.compact
+    def __call__(self, latents: jnp.ndarray, context: jnp.ndarray,
+                 train: bool = True):
+        """[B, px, py, latent] x2 → ([B, px, py, reduced] x2)."""
+        reduced_latents = nn.Conv(self.reduced_dim, (1, 1), use_bias=False,
+                                  padding="VALID", name="conv1")(latents)
+        prediction = nn.Conv(self.reduced_dim, (1, 1), use_bias=False,
+                             padding="VALID", name="conv2")(context)
+        return reduced_latents, prediction
+
+    def param_order(self) -> List[str]:
+        return ["conv1/kernel", "conv2/kernel"]
+
+    def train_order_block_ids(self) -> List[List[int]]:
+        # reference simple_models.py:512-514 — full net
+        return [[0, 1]]

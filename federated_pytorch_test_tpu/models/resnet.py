@@ -1,0 +1,163 @@
+"""CIFAR-variant ResNet9/18 with ELU activations.
+
+Re-design of reference simple_models.py:132-237: 3x3 stem (no 7x7/maxpool),
+4 stages, ELU everywhere ReLU would be, avg-pool 4, linear head.  BatchNorm
+affine params (scale/bias) are ordinary parameters — they participate in
+blocks and federation averaging, exactly as torch's ``net.parameters()``
+includes BN weight/bias; running stats live in the ``batch_stats`` collection,
+stay per-client and are never averaged (matching torch, where buffers are not
+in ``parameters()``; see SURVEY.md section 7 "BatchNorm under federation").
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from federated_pytorch_test_tpu.models.base import BlockModule, elu, pairs
+
+
+def _bn(name: str):
+    # torch BatchNorm2d defaults: eps=1e-5, momentum=0.1 (flax momentum=0.9)
+    return nn.BatchNorm(momentum=0.9, epsilon=1e-5, name=name)
+
+
+class BasicBlock(nn.Module):
+    """Two 3x3 convs + identity/projection shortcut (expansion 1).
+
+    Reference simple_models.py:132-154.
+    """
+
+    planes: int
+    stride: int = 1
+    expansion: int = 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        in_planes = x.shape[-1]
+        out = nn.Conv(self.planes, (3, 3), strides=(self.stride, self.stride),
+                      padding="SAME", use_bias=False, name="conv1")(x)
+        out = elu(_bn("bn1")(out, use_running_average=not train))
+        out = nn.Conv(self.planes, (3, 3), padding="SAME", use_bias=False,
+                      name="conv2")(out)
+        out = _bn("bn2")(out, use_running_average=not train)
+        if self.stride != 1 or in_planes != self.expansion * self.planes:
+            sc = nn.Conv(self.expansion * self.planes, (1, 1),
+                         strides=(self.stride, self.stride), use_bias=False,
+                         name="shortcut_conv")(x)
+            sc = _bn("shortcut_bn")(sc, use_running_average=not train)
+        else:
+            sc = x
+        return elu(out + sc)
+
+
+class Bottleneck(nn.Module):
+    """1x1 → 3x3 → 1x1 bottleneck (expansion 4).
+
+    Reference simple_models.py:157-182 (defined for parity; the reference
+    factories never reach it).
+    """
+
+    planes: int
+    stride: int = 1
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        in_planes = x.shape[-1]
+        out = nn.Conv(self.planes, (1, 1), use_bias=False, name="conv1")(x)
+        out = elu(_bn("bn1")(out, use_running_average=not train))
+        out = nn.Conv(self.planes, (3, 3), strides=(self.stride, self.stride),
+                      padding="SAME", use_bias=False, name="conv2")(out)
+        out = elu(_bn("bn2")(out, use_running_average=not train))
+        out = nn.Conv(self.expansion * self.planes, (1, 1), use_bias=False,
+                      name="conv3")(out)
+        out = _bn("bn3")(out, use_running_average=not train)
+        if self.stride != 1 or in_planes != self.expansion * self.planes:
+            sc = nn.Conv(self.expansion * self.planes, (1, 1),
+                         strides=(self.stride, self.stride), use_bias=False,
+                         name="shortcut_conv")(x)
+            sc = _bn("shortcut_bn")(sc, use_running_average=not train)
+        else:
+            sc = x
+        return elu(out + sc)
+
+
+_STAGE_PLANES = (64, 128, 256, 512)
+_STAGE_STRIDES = (1, 2, 2, 2)
+
+
+class ResNet(BlockModule):
+    """Reference simple_models.py:185-230 (CIFAR stem, ELU, avgpool 4)."""
+
+    num_blocks: Sequence[int] = (2, 2, 2, 2)
+    qualifier: int = 18  # 9 or 18 — selects the hand-made block partition
+    num_classes: int = 10
+    bottleneck: bool = False
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        out = nn.Conv(64, (3, 3), padding="SAME", use_bias=False, name="conv1")(x)
+        out = elu(_bn("bn1")(out, use_running_average=not train))
+        block_cls = Bottleneck if self.bottleneck else BasicBlock
+        for stage, (planes, stride, n) in enumerate(
+            zip(_STAGE_PLANES, _STAGE_STRIDES, self.num_blocks), start=1
+        ):
+            strides = [stride] + [1] * (n - 1)
+            for i, s in enumerate(strides):
+                out = block_cls(planes=planes, stride=s,
+                                name=f"layer{stage}_{i}")(out, train=train)
+        out = nn.avg_pool(out, window_shape=(4, 4), strides=(4, 4))
+        out = out.reshape((out.shape[0], -1))
+        return nn.Dense(self.num_classes, name="linear")(out)
+
+    # -- federation metadata ------------------------------------------------
+    def param_order(self) -> List[str]:
+        """Torch ``net.parameters()`` enumeration order of the reference ResNet.
+
+        Per BasicBlock: conv1.w, bn1.{scale,bias}, conv2.w, bn2.{scale,bias},
+        then (if projection) shortcut conv.w, shortcut bn.{scale,bias} — the
+        registration order of reference simple_models.py:135-147.
+        """
+        order: List[str] = ["conv1/kernel", "bn1/scale", "bn1/bias"]
+        expansion = 4 if self.bottleneck else 1
+        in_planes = 64
+        for stage, (planes, stride, n) in enumerate(
+            zip(_STAGE_PLANES, _STAGE_STRIDES, self.num_blocks), start=1
+        ):
+            strides = [stride] + [1] * (n - 1)
+            for i, s in enumerate(strides):
+                p = f"layer{stage}_{i}"
+                convs = ["conv1", "conv2"] + (["conv3"] if self.bottleneck else [])
+                for j, c in enumerate(convs, start=1):
+                    order += [f"{p}/{c}/kernel", f"{p}/bn{j}/scale", f"{p}/bn{j}/bias"]
+                if s != 1 or in_planes != expansion * planes:
+                    order += [f"{p}/shortcut_conv/kernel",
+                              f"{p}/shortcut_bn/scale", f"{p}/shortcut_bn/bias"]
+                in_planes = planes * expansion
+        order += ["linear/kernel", "linear/bias"]
+        return order
+
+    def train_order_block_ids(self) -> List[List[int]]:
+        # reference simple_models.py:222-226 — hand-made partitions
+        if self.qualifier == 18:
+            return [[0, 2], [3, 8], [9, 14], [15, 23], [24, 29], [30, 38],
+                    [39, 44], [45, 53], [54, 59], [60, 61]]
+        return [[0, 2], [3, 8], [9, 14], [15, 17], [18, 23], [24, 29],
+                [30, 32], [33, 37]]
+
+    def linear_layer_ids(self) -> List[int]:
+        # reference simple_models.py:229-230 (empty)
+        return []
+
+
+def ResNet18() -> ResNet:
+    """Reference simple_models.py:233-234."""
+    return ResNet(num_blocks=(2, 2, 2, 2), qualifier=18)
+
+
+def ResNet9() -> ResNet:
+    """Reference simple_models.py:236-237."""
+    return ResNet(num_blocks=(1, 1, 1, 1), qualifier=9)

@@ -1,0 +1,110 @@
+"""CIFAR10 CNN classifiers: Net, Net1, Net2.
+
+TPU-native (NHWC, Flax) re-designs of the reference model zoo:
+  * ``Net``  — LeNet-style CNN, reference simple_models.py:9-39
+  * ``Net1`` — mid CNN, reference simple_models.py:42-77
+  * ``Net2`` — large CNN, reference simple_models.py:81-128
+All use ELU activations (the reference "replaced relu with elu",
+simple_models.py:7).  Parameter counts match the reference exactly; kernels
+are HWIO and activations NHWC (vs torch OIHW/NCHW) for MXU-friendly layouts.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from federated_pytorch_test_tpu.models.base import (
+    BlockModule,
+    elu,
+    flatten,
+    max_pool_2x2,
+    pairs,
+)
+
+
+class Net(BlockModule):
+    """conv(3→6,5) → pool → conv(6→16,5) → pool → fc 400→120→84→10."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        x = max_pool_2x2(elu(nn.Conv(6, (5, 5), padding="VALID", name="conv1")(x)))
+        x = max_pool_2x2(elu(nn.Conv(16, (5, 5), padding="VALID", name="conv2")(x)))
+        x = flatten(x)  # 5*5*16 = 400
+        x = elu(nn.Dense(120, name="fc1")(x))
+        x = elu(nn.Dense(84, name="fc2")(x))
+        return nn.Dense(self.num_classes, name="fc3")(x)
+
+    def param_order(self) -> List[str]:
+        return pairs("conv1", "conv2", "fc1", "fc2", "fc3")
+
+    def linear_layer_ids(self) -> List[int]:
+        # reference simple_models.py:29-30 (layer ids over the 0..9 enumeration)
+        return [4, 6, 8]
+
+    def train_order_block_ids(self) -> List[List[int]]:
+        # reference simple_models.py:38-39
+        return [[4, 5], [0, 1], [2, 3], [6, 7], [8, 9]]
+
+
+class Net1(BlockModule):
+    """4 conv (32,32,64,64) + 2 pool + fc 1600→512→10."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        x = elu(nn.Conv(32, (3, 3), padding="VALID", name="conv1")(x))  # 30x30
+        x = elu(nn.Conv(32, (3, 3), padding="VALID", name="conv2")(x))  # 28x28
+        x = max_pool_2x2(x)  # 14x14
+        x = elu(nn.Conv(64, (3, 3), padding="VALID", name="conv3")(x))  # 12x12
+        x = elu(nn.Conv(64, (3, 3), padding="VALID", name="conv4")(x))  # 10x10
+        x = max_pool_2x2(x)  # 5x5
+        x = flatten(x)  # 64*5*5 = 1600
+        x = elu(nn.Dense(512, name="fc1")(x))
+        return nn.Dense(self.num_classes, name="fc2")(x)
+
+    def param_order(self) -> List[str]:
+        return pairs("conv1", "conv2", "conv3", "conv4", "fc1", "fc2")
+
+    def linear_layer_ids(self) -> List[int]:
+        # reference simple_models.py:67-68
+        return [8, 10]
+
+    def train_order_block_ids(self) -> List[List[int]]:
+        # reference simple_models.py:76-77
+        return [[4, 5], [10, 11], [2, 3], [6, 7], [0, 1], [8, 9]]
+
+
+class Net2(BlockModule):
+    """4 padded conv (64→512) + 4 pool + 5 fc (2048→128→256→512→1024→10)."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        x = max_pool_2x2(elu(nn.Conv(64, (3, 3), padding="SAME", name="conv1")(x)))  # 16
+        x = max_pool_2x2(elu(nn.Conv(128, (3, 3), padding="SAME", name="conv2")(x)))  # 8
+        x = max_pool_2x2(elu(nn.Conv(256, (3, 3), padding="SAME", name="conv3")(x)))  # 4
+        x = max_pool_2x2(elu(nn.Conv(512, (3, 3), padding="SAME", name="conv4")(x)))  # 2
+        x = flatten(x)  # 512*2*2 = 2048
+        x = elu(nn.Dense(128, name="fc1")(x))
+        x = elu(nn.Dense(256, name="fc2")(x))
+        x = elu(nn.Dense(512, name="fc3")(x))
+        x = elu(nn.Dense(1024, name="fc4")(x))
+        return nn.Dense(self.num_classes, name="fc5")(x)
+
+    def param_order(self) -> List[str]:
+        return pairs("conv1", "conv2", "conv3", "conv4", "fc1", "fc2", "fc3", "fc4", "fc5")
+
+    def linear_layer_ids(self) -> List[int]:
+        # reference simple_models.py:117-118
+        return [12, 14, 16]
+
+    def train_order_block_ids(self) -> List[List[int]]:
+        # reference simple_models.py:127-128
+        return [[14, 15], [4, 5], [2, 3], [8, 9], [16, 17], [12, 13], [6, 7], [0, 1], [10, 11]]

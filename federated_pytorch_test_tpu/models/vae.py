@@ -1,0 +1,74 @@
+"""Variational autoencoder for CIFAR10.
+
+Re-design of reference ``AutoEncoderCNN`` (simple_models.py:243-305):
+4 strided convs 32→2 px, fc 384→16→(mu, logvar), decode fc → 4 transposed
+convs → sigmoid.  Reparametrisation uses an explicit PRNG key instead of
+``torch.cuda.FloatTensor.normal_()`` (simple_models.py:292-301).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from federated_pytorch_test_tpu.models.base import BlockModule, elu, flatten, pairs
+
+_P1 = ((1, 1), (1, 1))  # torch padding=1
+
+
+class AutoEncoderCNN(BlockModule):
+    latent_dim: int = 10
+
+    def setup(self):
+        self.conv1 = nn.Conv(12, (4, 4), strides=(2, 2), padding=_P1, name="conv1")
+        self.conv2 = nn.Conv(24, (4, 4), strides=(2, 2), padding=_P1, name="conv2")
+        self.conv3 = nn.Conv(48, (4, 4), strides=(2, 2), padding=_P1, name="conv3")
+        self.conv4 = nn.Conv(96, (4, 4), strides=(2, 2), padding=_P1, name="conv4")
+        self.fc1 = nn.Dense(16, name="fc1")
+        self.fc21 = nn.Dense(self.latent_dim, name="fc21")
+        self.fc22 = nn.Dense(self.latent_dim, name="fc22")
+        self.fc3 = nn.Dense(384, name="fc3")
+        self.tconv1 = nn.ConvTranspose(48, (4, 4), strides=(2, 2), padding="SAME", name="tconv1")
+        self.tconv2 = nn.ConvTranspose(24, (4, 4), strides=(2, 2), padding="SAME", name="tconv2")
+        self.tconv3 = nn.ConvTranspose(12, (4, 4), strides=(2, 2), padding="SAME", name="tconv3")
+        self.tconv4 = nn.ConvTranspose(3, (4, 4), strides=(2, 2), padding="SAME", name="tconv4")
+
+    def encode(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        x = elu(self.conv1(x))  # 16x16x12
+        x = elu(self.conv2(x))  # 8x8x24
+        x = elu(self.conv3(x))  # 4x4x48
+        x = elu(self.conv4(x))  # 2x2x96
+        x = flatten(x)  # 384
+        x = elu(self.fc1(x))  # 16
+        return self.fc21(x), self.fc22(x)  # mu, logvar
+
+    def decode(self, z: jnp.ndarray) -> jnp.ndarray:
+        x = self.fc3(z)  # 384
+        x = x.reshape((-1, 2, 2, 96))
+        x = elu(self.tconv1(x))  # 4x4x48
+        x = elu(self.tconv2(x))  # 8x8x24
+        x = elu(self.tconv3(x))  # 16x16x12
+        x = elu(self.tconv4(x))  # 32x32x3
+        return jax.nn.sigmoid(x)
+
+    def reparametrize(self, mu, logvar, rng):
+        std = jnp.exp(0.5 * logvar)
+        eps = jax.random.normal(rng, std.shape, std.dtype)
+        return eps * std + mu
+
+    def __call__(self, x: jnp.ndarray, rng: jax.Array, train: bool = True):
+        mu, logvar = self.encode(x)
+        z = self.reparametrize(mu, logvar, rng)
+        return self.decode(z), mu, logvar
+
+    def param_order(self) -> List[str]:
+        return pairs("conv1", "conv2", "conv3", "conv4", "fc1", "fc21", "fc22",
+                     "fc3", "tconv1", "tconv2", "tconv3", "tconv4")
+
+    def train_order_block_ids(self) -> List[List[int]]:
+        # reference simple_models.py:304-305
+        return [[0, 1], [2, 3], [4, 5], [6, 7], [8, 9], [14, 15], [16, 17],
+                [18, 19], [20, 21], [22, 23], [10, 11], [12, 13]]

@@ -1,0 +1,20 @@
+from federated_pytorch_test_tpu.utils.tree import (  # noqa: F401
+    get_by_path,
+    set_by_path,
+    iter_paths,
+)
+from federated_pytorch_test_tpu.utils.blocks import (  # noqa: F401
+    BlockSpec,
+    block_paths,
+    build_mask,
+    mask_tree,
+    number_of_blocks,
+    number_of_layers,
+    layer_paths,
+)
+from federated_pytorch_test_tpu.utils.codec import (  # noqa: F401
+    get_trainable_values,
+    put_trainable_values,
+    masked_size,
+)
+from federated_pytorch_test_tpu.utils.initializers import init_weights  # noqa: F401

@@ -1,0 +1,89 @@
+"""Blockwise parameter partitions as static leaf masks.
+
+The reference implements partial-parameter ("blockwise") federation by mutating
+``requires_grad`` over the flat parameter list: ``unfreeze_one_block`` flips an
+index range ``[low, high]`` of ``net.parameters()`` to trainable (reference:
+simple_utils.py:34-45), and the hand-specified ranges live in each model's
+``train_order_block_ids()`` (reference: simple_models.py:38-39, :222-226).
+
+``requires_grad`` mutation is not expressible under ``jit``.  Here a block is a
+*static* set of parameter paths, realised as a boolean-per-leaf pytree mask.
+The mask is Python data (hashable, static under jit), so:
+
+  * local training multiplies gradients by the mask (frozen leaves get exact
+    zero updates, XLA-friendly static shapes);
+  * the communication codec (see codec.py) flattens *only* masked leaves, so
+    the number of exchanged bytes stays proportional to the active block —
+    preserving the reference's bandwidth-reduction property (README.md:2).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Mapping, Sequence, Tuple
+
+import jax
+
+from federated_pytorch_test_tpu.utils.tree import get_by_path, set_by_path
+
+
+BlockSpec = Sequence[Tuple[int, int]]  # [(low, high)] inclusive index ranges
+
+
+def block_paths(order: Sequence[str], block_ids: Sequence[int]) -> Tuple[str, ...]:
+    """Paths of the leaves in the inclusive index range ``block_ids=[low, high]``.
+
+    Mirrors reference simple_utils.py:34-45 (``ci >= llow and ci <= lhigh``).
+    """
+    low, high = block_ids
+    return tuple(order[low : high + 1])
+
+
+def layer_paths(order: Sequence[str], layer_id: int) -> Tuple[str, ...]:
+    """Paths of layer ``layer_id`` — indices ``2*layer_id`` and ``2*layer_id+1``.
+
+    Mirrors reference ``unfreeze_one_layer`` (simple_utils.py:16-22): a "layer"
+    is a (weight, bias) pair in the flat enumeration.
+    """
+    out = []
+    for idx in (2 * layer_id, 2 * layer_id + 1):
+        if idx < len(order):
+            out.append(order[idx])
+    return tuple(out)
+
+
+def build_mask(params: Mapping[str, Any], active_paths: Sequence[str]):
+    """A pytree of Python bools matching ``params``: True iff leaf is trainable."""
+    active = set(active_paths)
+    mask = jax.tree.map(lambda _: False, params)
+    for path in active:
+        mask = set_by_path(mask, path, True)
+    return mask
+
+
+def mask_tree(tree, mask, zero_like=None):
+    """Zero-out (or replace by ``zero_like``) the leaves where mask is False."""
+    import jax.numpy as jnp
+
+    def f(m, x):
+        if m:
+            return x
+        return jnp.zeros_like(x) if zero_like is None else zero_like
+
+    return jax.tree.map(f, mask, tree)
+
+
+def select_mask(mask, if_true, if_false):
+    """Per-leaf select: leaf from ``if_true`` where mask True, else ``if_false``."""
+    return jax.tree.map(
+        lambda m, a, b: a if m else b, mask, if_true, if_false
+    )
+
+
+def number_of_layers(order: Sequence[str]) -> int:
+    """Total number of (weight|bias) entries — reference simple_utils.py:79-83."""
+    return len(order)
+
+
+def number_of_blocks(blocks: Sequence[BlockSpec]) -> int:
+    """Reference simple_utils.py:85-87."""
+    return len(blocks)

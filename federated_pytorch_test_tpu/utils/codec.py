@@ -1,0 +1,56 @@
+"""Flat-vector codec over masked parameter pytrees.
+
+TPU-native re-design of the reference's entire "communication codec":
+``get_trainable_values`` flattens all ``requires_grad`` parameters into one 1-D
+vector and ``put_trainable_values`` scatters a vector back (reference:
+simple_utils.py:47-77).  Here trainability is a static leaf mask and the flat
+order is the model's published ``param_order()`` — identical semantics, but
+pure-functional and jit-compatible (static shapes per block).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from federated_pytorch_test_tpu.utils.tree import get_by_path, set_by_path
+
+
+def active_paths_in_order(order: Sequence[str], mask: Mapping[str, Any]) -> list:
+    return [p for p in order if get_by_path(mask, p)]
+
+
+def masked_size(params: Mapping[str, Any], order: Sequence[str], mask) -> int:
+    """Number of scalars in the active block (``N`` in the reference drivers)."""
+    n = 0
+    for p in active_paths_in_order(order, mask):
+        n += int(np.prod(get_by_path(params, p).shape))
+    return n
+
+
+def get_trainable_values(params: Mapping[str, Any], order: Sequence[str], mask) -> jnp.ndarray:
+    """Flatten active leaves (in ``order``) into one 1-D vector.
+
+    Functional analogue of reference simple_utils.py:47-66.
+    """
+    chunks = [jnp.ravel(get_by_path(params, p)) for p in active_paths_in_order(order, mask)]
+    if not chunks:
+        return jnp.zeros((0,), dtype=jnp.float32)
+    return jnp.concatenate(chunks, axis=0)
+
+
+def put_trainable_values(params: Mapping[str, Any], order: Sequence[str], mask, vec: jnp.ndarray):
+    """Scatter a flat vector back into the active leaves (in ``order``).
+
+    Functional analogue of reference simple_utils.py:68-77; returns new params.
+    """
+    out = params
+    offset = 0
+    for p in active_paths_in_order(order, mask):
+        leaf = get_by_path(params, p)
+        n = int(np.prod(leaf.shape))
+        out = set_by_path(out, p, jnp.reshape(vec[offset : offset + n], leaf.shape).astype(leaf.dtype))
+        offset += n
+    return out

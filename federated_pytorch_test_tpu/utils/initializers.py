@@ -1,0 +1,61 @@
+"""Weight initialisation matching the reference's ``init_weights``.
+
+Reference (simple_utils.py:9-14): Xavier-uniform on Conv2d/Linear weights,
+bias filled with 0.01; BatchNorm left at its default (scale=1, bias=0).  The
+reference seeds ``torch.manual_seed(0)`` before applying it to *every* client
+so all K clients start identical (federated_multi.py:124-128) — here the same
+effect comes from initialising once with a fixed PRNG key and broadcasting
+over the client axis.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _default_exclude(path: str) -> bool:
+    """Reference parity: ``init_weights`` type-checks ``nn.Linear``/``nn.Conv2d``
+    only (simple_utils.py:10), so ConvTranspose layers (the VAE decoders,
+    named ``tconv*``) keep their default init and BatchNorm is untouched."""
+    return path.split("/")[-2].startswith("tconv") if "/" in path else False
+
+
+def init_weights(params, rng: jax.Array,
+                 exclude: Optional[Callable[[str], bool]] = None):
+    """Re-initialise a Flax param tree: xavier_uniform kernels, 0.01 biases.
+
+    Kernels are leaves named ``kernel`` of Conv/Dense modules (identified by
+    having a ``kernel`` sibling); BN scale/bias are left untouched, matching
+    the reference where ``init_weights`` only hits Linear/Conv2d.  ``exclude``
+    is a path predicate for modules the reference's type check skips
+    (default: ConvTranspose ``tconv*`` modules).
+    """
+    xavier = jax.nn.initializers.xavier_uniform()
+    if exclude is None:
+        exclude = _default_exclude
+
+    def rec(tree, key, prefix):
+        if not isinstance(tree, dict):
+            return tree
+        out = {}
+        has_kernel = "kernel" in tree
+        for name in sorted(tree.keys()):
+            leaf = tree[name]
+            path = f"{prefix}/{name}" if prefix else name
+            key, sub = jax.random.split(key)
+            if isinstance(leaf, dict):
+                out[name] = rec(leaf, sub, path)
+            elif name == "kernel" and not exclude(path):
+                # torch xavier_uniform on OIHW == jax xavier_uniform fan
+                # computed over the same in/out dims for HWIO/IO layouts.
+                out[name] = xavier(sub, leaf.shape, leaf.dtype)
+            elif name == "bias" and has_kernel and not exclude(path):
+                out[name] = jnp.full_like(leaf, 0.01)
+            else:
+                out[name] = leaf
+        return out
+
+    return rec(params, rng, "")

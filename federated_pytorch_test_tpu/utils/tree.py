@@ -1,0 +1,55 @@
+"""Path-addressed access into nested parameter pytrees.
+
+The reference framework identifies parameters by their position in the flat
+``net.parameters()`` enumeration (reference: simple_utils.py:41-45).  Here the
+canonical identifier is a ``'/'``-joined path into the nested params dict
+(e.g. ``"layer1_0/conv1/kernel"``); every model publishes its torch-definition
+parameter order as a list of such paths (``Model.param_order()``), which is the
+basis for block masks and the flat codec.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Mapping, Tuple
+
+
+def iter_paths(tree: Mapping[str, Any], prefix: str = "") -> Iterator[Tuple[str, Any]]:
+    """Yield (path, leaf) pairs for every leaf, in sorted key order."""
+    for key in sorted(tree.keys()):
+        sub = tree[key]
+        path = f"{prefix}/{key}" if prefix else key
+        if isinstance(sub, Mapping):
+            yield from iter_paths(sub, path)
+        else:
+            yield path, sub
+
+
+def get_by_path(tree: Mapping[str, Any], path: str) -> Any:
+    node: Any = tree
+    for part in path.split("/"):
+        node = node[part]
+    return node
+
+
+def set_by_path(tree: Mapping[str, Any], path: str, value: Any) -> dict:
+    """Return a copy of ``tree`` with the leaf at ``path`` replaced."""
+    parts = path.split("/")
+
+    def rec(node: Mapping[str, Any], i: int) -> dict:
+        out = dict(node)
+        if i == len(parts) - 1:
+            out[parts[i]] = value
+        else:
+            out[parts[i]] = rec(node[parts[i]], i + 1)
+        return out
+
+    return rec(tree, 0)
+
+
+def has_path(tree: Mapping[str, Any], path: str) -> bool:
+    node: Any = tree
+    for part in path.split("/"):
+        if not isinstance(node, Mapping) or part not in node:
+            return False
+        node = node[part]
+    return True

@@ -1,0 +1,235 @@
+"""Model-zoo parity tests: shapes, param counts, param_order, block partitions.
+
+Expected parameter counts are computed from the reference architectures
+(/root/reference/src/simple_models.py); see SURVEY.md section 2 approximate
+counts (Net ~62k, Net2 ~2.6M, ResNet18 ~11.2M, AutoEncoderCNN ~110k,
+EncoderCNN(Lc=256) ~1.1M).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from federated_pytorch_test_tpu.models import (
+    AutoEncoderCNN,
+    AutoEncoderCNNCL,
+    ContextgenCNN,
+    EncoderCNN,
+    Net,
+    Net1,
+    Net2,
+    PredictorCNN,
+    ResNet9,
+    ResNet18,
+)
+from federated_pytorch_test_tpu.utils.tree import get_by_path, iter_paths
+
+
+def n_params(tree):
+    return sum(int(np.prod(x.shape)) for _, x in iter_paths(tree))
+
+
+def torch_param_count_conv(cin, cout, k, bias=True):
+    return cout * cin * k * k + (cout if bias else 0)
+
+
+def torch_param_count_dense(fin, fout, bias=True):
+    return fin * fout + (fout if bias else 0)
+
+
+CIFAR = (2, 32, 32, 3)
+
+
+def init_model(model, *args, **kwargs):
+    return model.init_variables(jax.random.PRNGKey(0), *args, **kwargs)
+
+
+class TestNet:
+    def test_forward_shape_and_params(self):
+        model = Net()
+        params, _ = init_model(model, jnp.zeros(CIFAR))
+        out = model.apply({"params": params}, jnp.zeros(CIFAR))
+        assert out.shape == (2, 10)
+        # conv(3->6,5)+conv(6->16,5)+fc 400x120+120x84+84x10
+        expected = (torch_param_count_conv(3, 6, 5) + torch_param_count_conv(6, 16, 5)
+                    + torch_param_count_dense(400, 120) + torch_param_count_dense(120, 84)
+                    + torch_param_count_dense(84, 10))
+        assert n_params(params) == expected == 62006
+
+    def test_param_order_covers_all(self):
+        model = Net()
+        params, _ = init_model(model, jnp.zeros(CIFAR))
+        order = model.param_order()
+        assert len(order) == 10
+        assert sorted(order) == sorted(p for p, _ in iter_paths(params))
+        # blocks cover 0..9 exactly once (reference simple_models.py:38-39)
+        covered = sorted(i for lo, hi in model.train_order_block_ids() for i in range(lo, hi + 1))
+        assert covered == list(range(10))
+
+
+class TestNet1:
+    def test_forward_shape_and_params(self):
+        model = Net1()
+        params, _ = init_model(model, jnp.zeros(CIFAR))
+        out = model.apply({"params": params}, jnp.zeros(CIFAR))
+        assert out.shape == (2, 10)
+        expected = (torch_param_count_conv(3, 32, 3) + torch_param_count_conv(32, 32, 3)
+                    + torch_param_count_conv(32, 64, 3) + torch_param_count_conv(64, 64, 3)
+                    + torch_param_count_dense(1600, 512) + torch_param_count_dense(512, 10))
+        assert n_params(params) == expected
+
+    def test_blocks(self):
+        model = Net1()
+        covered = sorted(i for lo, hi in model.train_order_block_ids() for i in range(lo, hi + 1))
+        assert covered == list(range(12))
+        assert len(model.param_order()) == 12
+
+
+class TestNet2:
+    def test_forward_shape_and_params(self):
+        model = Net2()
+        params, _ = init_model(model, jnp.zeros(CIFAR))
+        out = model.apply({"params": params}, jnp.zeros(CIFAR))
+        assert out.shape == (2, 10)
+        expected = (torch_param_count_conv(3, 64, 3) + torch_param_count_conv(64, 128, 3)
+                    + torch_param_count_conv(128, 256, 3) + torch_param_count_conv(256, 512, 3)
+                    + torch_param_count_dense(2048, 128) + torch_param_count_dense(128, 256)
+                    + torch_param_count_dense(256, 512) + torch_param_count_dense(512, 1024)
+                    + torch_param_count_dense(1024, 10))
+        assert n_params(params) == expected
+        assert expected > 2_500_000  # ~2.6M per SURVEY
+
+    def test_blocks(self):
+        model = Net2()
+        covered = sorted(i for lo, hi in model.train_order_block_ids() for i in range(lo, hi + 1))
+        assert covered == list(range(18))
+        assert len(model.param_order()) == 18
+
+
+class TestResNet:
+    @pytest.mark.parametrize("factory,n_entries", [(ResNet18, 62), (ResNet9, 38)])
+    def test_param_order_matches_params(self, factory, n_entries):
+        model = factory()
+        params, batch_stats = init_model(model, jnp.zeros(CIFAR), train=False)
+        order = model.param_order()
+        assert len(order) == n_entries
+        assert sorted(order) == sorted(p for p, _ in iter_paths(params))
+        # block partition covers the whole enumeration exactly once
+        covered = sorted(i for lo, hi in model.train_order_block_ids() for i in range(lo, hi + 1))
+        assert covered == list(range(n_entries))
+        # batch_stats exist for every BN layer (param scale ↔ stats mean)
+        bn_scales = [p for p in order if p.endswith("/scale")]
+        for p in bn_scales:
+            get_by_path(batch_stats, p.replace("/scale", "/mean"))
+
+    def test_resnet18_forward_and_count(self):
+        model = ResNet18()
+        params, batch_stats = init_model(model, jnp.zeros(CIFAR), train=False)
+        out = model.apply({"params": params, "batch_stats": batch_stats},
+                          jnp.zeros(CIFAR), train=False)
+        assert out.shape == (2, 10)
+        total = n_params(params)
+        assert total == 11_173_962  # torchvision-style CIFAR ResNet18 count
+
+    def test_resnet18_train_mode_updates_stats(self):
+        model = ResNet18()
+        params, batch_stats = init_model(model, jnp.zeros(CIFAR), train=False)
+        x = jax.random.normal(jax.random.PRNGKey(1), CIFAR)
+        out, mutated = model.apply(
+            {"params": params, "batch_stats": batch_stats}, x, train=True,
+            mutable=["batch_stats"])
+        assert out.shape == (2, 10)
+        old = batch_stats["bn1"]["mean"]
+        new = mutated["batch_stats"]["bn1"]["mean"]
+        assert not np.allclose(old, new)
+
+
+class TestVAE:
+    def test_forward_shapes(self):
+        model = AutoEncoderCNN()
+        rng = jax.random.PRNGKey(0)
+        params, _ = init_model(model, jnp.zeros(CIFAR), rng)
+        recon, mu, logvar = model.apply({"params": params}, jnp.zeros(CIFAR), rng)
+        assert recon.shape == CIFAR
+        assert mu.shape == (2, 10) and logvar.shape == (2, 10)
+        assert (recon >= 0).all() and (recon <= 1).all()  # sigmoid output
+        assert len(model.param_order()) == 24
+        covered = sorted(i for lo, hi in model.train_order_block_ids() for i in range(lo, hi + 1))
+        assert covered == list(range(24))
+
+    def test_param_count(self):
+        model = AutoEncoderCNN()
+        params, _ = init_model(model, jnp.zeros(CIFAR), jax.random.PRNGKey(0))
+        expected = (
+            torch_param_count_conv(3, 12, 4) + torch_param_count_conv(12, 24, 4)
+            + torch_param_count_conv(24, 48, 4) + torch_param_count_conv(48, 96, 4)
+            + torch_param_count_dense(384, 16) + 2 * torch_param_count_dense(16, 10)
+            + torch_param_count_dense(10, 384)
+            + torch_param_count_conv(96, 48, 4) + torch_param_count_conv(48, 24, 4)
+            + torch_param_count_conv(24, 12, 4) + torch_param_count_conv(12, 3, 4))
+        assert n_params(params) == expected
+
+
+class TestVAECL:
+    def test_forward_shapes(self):
+        model = AutoEncoderCNNCL(K=4, L=8)
+        rng = jax.random.PRNGKey(0)
+        params, _ = init_model(model, jnp.zeros(CIFAR), rng)
+        ekhat, mu_xi, sig2_xi, mu_b, sig2_b, mu_th, sig2_th = model.apply(
+            {"params": params}, jnp.zeros(CIFAR), rng)
+        assert ekhat.shape == (2, 4)
+        np.testing.assert_allclose(np.asarray(ekhat.sum(axis=1)), 1.0, rtol=1e-5)
+        assert mu_xi.shape == (4, 2, 8) and sig2_xi.shape == (4, 2, 8)
+        assert mu_b.shape == (4, 2, 8) and sig2_b.shape == (4, 2, 8)
+        assert mu_th.shape == (4,) + CIFAR and sig2_th.shape == (4,) + CIFAR
+        assert (np.asarray(sig2_xi) >= 0).all() and (np.asarray(sig2_th) >= 0).all()
+
+    def test_blocks_and_order(self):
+        model = AutoEncoderCNNCL()
+        rng = jax.random.PRNGKey(0)
+        params, _ = init_model(model, jnp.zeros(CIFAR), rng)
+        order = model.param_order()
+        assert len(order) == 42
+        assert sorted(order) == sorted(p for p, _ in iter_paths(params))
+        covered = sorted(i for lo, hi in model.train_order_block_ids() for i in range(lo, hi + 1))
+        assert covered == list(range(42))
+
+    def test_reparam_flag(self):
+        model = AutoEncoderCNNCL(K=2, L=4)
+        rng = jax.random.PRNGKey(0)
+        params, _ = init_model(model, jnp.zeros(CIFAR), rng)
+        out1 = model.apply({"params": params}, jnp.zeros(CIFAR), rng, reparam=False)
+        out2 = model.apply({"params": params}, jnp.zeros(CIFAR), rng, reparam=False)
+        np.testing.assert_allclose(np.asarray(out1[3]), np.asarray(out2[3]))
+
+
+class TestCPC:
+    def test_encoder(self):
+        model = EncoderCNN(latent_dim=256)
+        x = jnp.zeros((4, 32, 32, 8))
+        params, _ = init_model(model, x)
+        out = model.apply({"params": params}, x)
+        assert out.shape == (4, 256)
+        assert len(model.param_order()) == 16
+        expected = (
+            5 * torch_param_count_conv(8, 8, 4)
+            + torch_param_count_conv(40, 64, 4)
+            + torch_param_count_conv(64, 128, 4)
+            + torch_param_count_conv(128, 256, 4))
+        assert n_params(params) == expected
+
+    def test_contextgen_shape_preserving(self):
+        model = ContextgenCNN(latent_dim=64)
+        x = jnp.zeros((2, 3, 3, 64))
+        params, _ = init_model(model, x)
+        out = model.apply({"params": params}, x)
+        assert out.shape == x.shape
+        assert len(model.param_order()) == 4  # bias-free convs
+
+    def test_predictor(self):
+        model = PredictorCNN(latent_dim=64, reduced_dim=16)
+        lat = jnp.zeros((2, 3, 3, 64))
+        params, _ = init_model(model, lat, lat)
+        rl, pred = model.apply({"params": params}, lat, lat)
+        assert rl.shape == (2, 3, 3, 16) and pred.shape == (2, 3, 3, 16)
