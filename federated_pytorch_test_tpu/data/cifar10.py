@@ -1,0 +1,250 @@
+"""CIFAR10 federated data pipeline.
+
+Re-design of the reference's per-client loader block (duplicated ~35 lines in
+6 drivers; canonical copy federated_multi.py:52-85):
+
+  * 50000 train images split into K contiguous index ranges with
+    ``K_perslave = floor((50000 + K - 1) / K)`` (federated_multi.py:54);
+    the reference's off-by-one (each shard's range ends at
+    ``K_perslave*(ck+1)-1`` *exclusive*, dropping one sample per shard,
+    no_consensus_multi.py:43-46) is reproduced behind ``drop_last_sample``
+    (default True for parity);
+  * normalisation to [-1, 1] (``Normalize((0.5,0.5,0.5),(0.5,0.5,0.5))``),
+    with optional per-client biased means ``(0.5 + k/100, 0.5 - k/100, 0.5)``
+    simulating non-IID inputs (``biased_input``, federated_multi.py:60-71);
+  * every client evaluates on the full 10000-image test set
+    (federated_multi.py:84-85).
+
+TPU-first: instead of K torch ``DataLoader`` objects iterated sequentially,
+the pipeline materialises dense ``[K, steps, batch, 32, 32, 3]`` NHWC arrays
+(one leading client axis to shard over the mesh) and reshuffles per epoch with
+a numpy ``Generator`` — all device work is one ``device_put`` per epoch.
+
+Data source: real CIFAR-10 python-pickle batches (``data_batch_1..5``,
+``test_batch``) if a directory is found/given; otherwise a deterministic
+synthetic CIFAR-10 lookalike (class-structured images, same shapes/counts) so
+the framework trains and benchmarks end-to-end in a zero-egress environment.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+TRAIN_SIZE = 50000
+TEST_SIZE = 10000
+NUM_CLASSES = 10
+IMAGE_SHAPE = (32, 32, 3)
+
+_SEARCH_DIRS = (
+    "./data/cifar-10-batches-py",
+    "./cifar-10-batches-py",
+    "/root/data/cifar-10-batches-py",
+    os.path.expanduser("~/.cache/cifar-10-batches-py"),
+)
+
+
+def _load_pickle_batches(dirname: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Read the standard CIFAR-10 python pickle batches into NHWC uint8."""
+
+    def read(name):
+        with open(os.path.join(dirname, name), "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        x = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        y = np.asarray(d[b"labels"], dtype=np.int32)
+        return x, y
+
+    xs, ys = zip(*(read(f"data_batch_{i}") for i in range(1, 6)))
+    xte, yte = read("test_batch")
+    return np.concatenate(xs), np.concatenate(ys), xte, yte
+
+
+def _synthetic_cifar10(seed: int = 0) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Deterministic CIFAR-10 stand-in with learnable class structure.
+
+    Each class c gets a fixed low-frequency template image; samples are the
+    template plus moderate pixel noise, clipped to uint8.  A linear probe
+    separates the classes, and accuracy curves behave qualitatively like the
+    real dataset (rises well above 10% chance), which is what the reference's
+    only benchmark artifact measures (README.md:28-30).
+    """
+    rng = np.random.default_rng(seed)
+    # low-frequency templates: upsampled 4x4 random patterns per class/channel
+    coarse = rng.uniform(40.0, 215.0, size=(NUM_CLASSES, 4, 4, 3))
+    templates = np.repeat(np.repeat(coarse, 8, axis=1), 8, axis=2)  # [10,32,32,3]
+
+    def make(n, rng):
+        y = rng.integers(0, NUM_CLASSES, size=n).astype(np.int32)
+        noise = rng.normal(0.0, 48.0, size=(n,) + IMAGE_SHAPE)
+        x = np.clip(templates[y] + noise, 0, 255).astype(np.uint8)
+        return x, y
+
+    xtr, ytr = make(TRAIN_SIZE, rng)
+    xte, yte = make(TEST_SIZE, rng)
+    return xtr, ytr, xte, yte
+
+
+def load_cifar10_arrays(data_dir: Optional[str] = None, synthetic_seed: int = 0):
+    """(train_x, train_y, test_x, test_y) as (uint8 NHWC, int32) arrays.
+
+    Tries ``data_dir``, then $CIFAR10_DIR, then the standard search paths;
+    falls back to the synthetic dataset.  Returns a 5th element: the source
+    tag ('disk' or 'synthetic').
+    """
+    candidates: List[str] = []
+    if data_dir:
+        candidates.append(data_dir)
+    if os.environ.get("CIFAR10_DIR"):
+        candidates.append(os.environ["CIFAR10_DIR"])
+    candidates.extend(_SEARCH_DIRS)
+    for d in candidates:
+        if os.path.isfile(os.path.join(d, "data_batch_1")):
+            return (*_load_pickle_batches(d), "disk")
+    return (*_synthetic_cifar10(synthetic_seed), "synthetic")
+
+
+def normalize(x_uint8: np.ndarray, mean: Tuple[float, float, float]) -> np.ndarray:
+    """ToTensor + Normalize(mean, (0.5, 0.5, 0.5)) — federated_multi.py:62-71."""
+    x = x_uint8.astype(np.float32) / 255.0
+    m = np.asarray(mean, dtype=np.float32)
+    return (x - m) / 0.5
+
+
+def client_means(K: int, biased_input: bool) -> np.ndarray:
+    """Per-client normalisation means — federated_multi.py:60-71."""
+    if not biased_input:
+        return np.tile(np.float32([0.5, 0.5, 0.5]), (K, 1))
+    ks = np.arange(K, dtype=np.float32)
+    return np.stack([0.5 + ks / 100.0, 0.5 - ks / 100.0, np.full(K, 0.5, np.float32)], axis=1)
+
+
+def shard_indices(K: int, n: int = TRAIN_SIZE, drop_last_sample: bool = True) -> List[np.ndarray]:
+    """Contiguous 1/K index ranges — federated_multi.py:52-58.
+
+    ``drop_last_sample=True`` reproduces the reference's exclusive upper bound
+    ``K_perslave*(ck+1)-1`` which silently drops one sample per shard
+    (SURVEY.md section 7 quirks list).
+    """
+    per = (n + K - 1) // K
+    out = []
+    for ck in range(K):
+        hi = min(per * (ck + 1), n)
+        if drop_last_sample:
+            hi = min(per * (ck + 1) - 1, n)
+        out.append(np.arange(per * ck, hi))
+    return out
+
+
+@dataclass
+class FederatedCifar10:
+    """K-client CIFAR10 with dense per-epoch batch tensors.
+
+    Usage::
+
+        data = FederatedCifar10(K=8, batch=128, biased_input=False)
+        xb, yb = data.epoch_batches(rng_seed)   # [K, steps, B, 32, 32, 3], [K, steps, B]
+        xt, yt = data.test_batches()            # [K, tsteps, B, 32, 32, 3], ...
+
+    The leading axis is the client mesh axis.  Every client gets the same
+    number of steps (shards are equal-sized by construction); the per-epoch
+    shuffle matches the reference's ``SubsetRandomSampler`` semantics
+    (federated_multi.py:74-83) with an explicit numpy Generator.
+    """
+
+    K: int = 10
+    batch: int = 128
+    biased_input: bool = False
+    drop_last_sample: bool = True
+    data_dir: Optional[str] = None
+    synthetic_seed: int = 0
+    limit_per_client: Optional[int] = None  # cap shard size (tests/benchmarks)
+    limit_test: Optional[int] = None        # cap test-set size (tests)
+    # filled in __post_init__
+    source: str = field(init=False, default="")
+
+    def __post_init__(self):
+        xtr, ytr, xte, yte, src = load_cifar10_arrays(self.data_dir, self.synthetic_seed)
+        self.source = src
+        self._means = client_means(self.K, self.biased_input)
+        idx = shard_indices(self.K, len(xtr), self.drop_last_sample)
+        n_min = min(len(i) for i in idx)
+        if self.limit_per_client:
+            n_min = min(n_min, self.limit_per_client)
+        if self.limit_test:
+            xte, yte = xte[: self.limit_test], yte[: self.limit_test]
+        self.steps = n_min // self.batch
+        # store raw uint8 shards; normalisation is applied per epoch (cheap,
+        # and biased means are per-client so can't be pre-folded globally)
+        self._train_x = np.stack([xtr[i[:n_min]] for i in idx])  # [K, n, 32,32,3] u8
+        self._train_y = np.stack([ytr[i[:n_min]] for i in idx]).astype(np.int32)
+        self._test_x = xte
+        self._test_y = yte.astype(np.int32)
+
+    @property
+    def samples_per_client(self) -> int:
+        return self._train_x.shape[1]
+
+    @property
+    def means(self) -> np.ndarray:
+        """Per-client normalisation means [K, 3] (federated_multi.py:60-71)."""
+        return self._means
+
+    def epoch_batches_raw(self, seed: int) -> Tuple[np.ndarray, np.ndarray]:
+        """One shuffled epoch as raw uint8: [K, steps, B, 32,32,3], [K, steps, B].
+
+        Normalisation happens on-device inside the jitted step (the engine
+        folds in the per-client biased means), so the host only permutes
+        uint8 — 4x less host->device traffic than staging float32.
+        """
+        rng = np.random.default_rng(seed)
+        n = self.steps * self.batch
+        xs, ys = [], []
+        for ck in range(self.K):
+            perm = rng.permutation(self.samples_per_client)[:n]
+            xs.append(self._train_x[ck, perm].reshape(
+                self.steps, self.batch, *IMAGE_SHAPE))
+            ys.append(self._train_y[ck, perm].reshape(self.steps, self.batch))
+        return np.stack(xs), np.stack(ys)
+
+    def test_batches_raw(self, batch: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
+        """Full test set ONCE (not per client) as uint8 [tsteps, B, ...] plus
+        labels [tsteps, B]; clients differ only in their normalisation means,
+        which the engine applies on-device."""
+        b = batch or self.batch
+        tsteps = len(self._test_x) // b
+        n = tsteps * b
+        return (self._test_x[:n].reshape(tsteps, b, *IMAGE_SHAPE),
+                self._test_y[:n].reshape(tsteps, b))
+
+    def epoch_batches(self, seed: int) -> Tuple[np.ndarray, np.ndarray]:
+        """One epoch of shuffled minibatches: [K, steps, B, 32,32,3] f32, [K, steps, B] i32."""
+        rng = np.random.default_rng(seed)
+        n = self.steps * self.batch
+        xs, ys = [], []
+        for ck in range(self.K):
+            perm = rng.permutation(self.samples_per_client)[:n]
+            x = normalize(self._train_x[ck, perm], tuple(self._means[ck]))
+            xs.append(x.reshape(self.steps, self.batch, *IMAGE_SHAPE))
+            ys.append(self._train_y[ck, perm].reshape(self.steps, self.batch))
+        return np.stack(xs), np.stack(ys)
+
+    def test_batches(self, batch: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
+        """Full test set, replicated per client with that client's transform.
+
+        Reference parity: every client evaluates on the complete 10k test set
+        under its own (possibly biased) normalisation (federated_multi.py:84-85,
+        :108-121).  Returns [K, tsteps, B, ...] arrays (remainder dropped).
+        """
+        b = batch or self.batch
+        tsteps = len(self._test_x) // b
+        n = tsteps * b
+        xs = []
+        for ck in range(self.K):
+            x = normalize(self._test_x[:n], tuple(self._means[ck]))
+            xs.append(x.reshape(tsteps, b, *IMAGE_SHAPE))
+        y = np.tile(self._test_y[:n].reshape(1, tsteps, b), (self.K, 1, 1))
+        return np.stack(xs), y

@@ -1,0 +1,23 @@
+"""Device-mesh parallelism: the client axis and federated collectives.
+
+The reference's "distributed" layer is a sequential Python loop over a dict of
+models with in-memory tensor averaging (federated_multi.py:168, :208-211) —
+there is no communication backend at all (SURVEY.md section 2).  Here the K
+federated clients live on a ``jax.sharding.Mesh`` axis ``'clients'``; parameter
+exchange is ``lax.pmean``/``psum`` riding ICI (DCN across slices on multi-host,
+same code), and the bandwidth-proportional-to-active-block property is kept by
+exchanging only the masked flat block vector.
+"""
+
+from federated_pytorch_test_tpu.parallel.mesh import (  # noqa: F401
+    CLIENT_AXIS,
+    client_mesh,
+    client_sharding,
+    replicated_sharding,
+    shard_clients,
+)
+from federated_pytorch_test_tpu.parallel.comm import (  # noqa: F401
+    all_clients_dot,
+    federated_mean,
+    federated_sum,
+)

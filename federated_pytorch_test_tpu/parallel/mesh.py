@@ -1,0 +1,55 @@
+"""Client-axis mesh construction.
+
+Design (SURVEY.md section 7, decision 1): the K clients are a leading axis of
+every stacked pytree, sharded over the mesh axis ``'clients'``.  When K exceeds
+the device count each device holds a contiguous group of K/D clients (vmapped
+locally inside ``shard_map``); when K equals the device count it is one client
+per chip.  K must be a multiple of the device count used.
+
+On hardware this axis lays onto ICI within a slice and DCN across slices
+automatically via the standard device order of ``jax.sharding.Mesh``; tests run
+the same code on a virtual 8-device CPU mesh (tests/conftest.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+CLIENT_AXIS = "clients"
+
+
+def client_mesh(num_devices: Optional[int] = None,
+                devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """A 1-D mesh over ``num_devices`` devices with axis ``'clients'``."""
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (CLIENT_AXIS,))
+
+
+def usable_device_count(K: int, mesh_or_devices=None) -> int:
+    """Largest device count D <= len(devices) with K % D == 0."""
+    n = len(jax.devices() if mesh_or_devices is None else mesh_or_devices)
+    d = min(n, K)
+    while K % d:
+        d -= 1
+    return d
+
+
+def client_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (client) axis across the mesh."""
+    return NamedSharding(mesh, P(CLIENT_AXIS))
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_clients(tree, mesh: Mesh):
+    """device_put every leaf with its leading axis sharded over 'clients'."""
+    sh = client_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)

@@ -1,0 +1,64 @@
+"""Run configuration.
+
+The reference configures each driver with module-level constants edited
+in-source (federated_multi.py:9-48, consensus_multi.py:9-59).  The rebuild
+keeps the same knob *names* in one dataclass per entry point (SURVEY.md
+section 5 "Config / flag system"); ``use_cuda`` becomes ``use_tpu``
+(BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class FederatedConfig:
+    """Knobs shared by every CIFAR10 federated driver.
+
+    Defaults follow federated_multi.py:9-48 / consensus_multi.py:9-59.
+    """
+
+    K: int = 10                    # number of models (== slaves/clients)
+    default_batch: int = 128       # minibatch size
+    Nloop: int = 12                # loops over the whole network
+    Nepoch: int = 1                # local epochs per round
+    Nadmm: int = 3                 # communication (averaging/ADMM) rounds
+    seed: int = 69                 # torch.manual_seed(69) analogue
+    init_seed: int = 0             # common-init seed (federated_multi.py:126)
+
+    # regularisation (federated_multi.py:27-28, consensus_multi.py:27-29)
+    lambda1: float = 1e-4          # L1
+    lambda2: float = 1e-4          # L2
+    admm_rho0: float = 1.0         # FedProx rho / ADMM penalty (0.1 for consensus)
+
+    # flags (federated_multi.py:30-43)
+    load_model: bool = False
+    init_model: bool = True
+    save_model: bool = True
+    check_results: bool = True
+    biased_input: bool = False
+    be_verbose: bool = False
+    use_resnet: bool = False
+    use_tpu: bool = True           # reference `use_cuda` (BASELINE.json rename)
+
+    # adaptive-ADMM Barzilai-Borwein knobs (consensus_multi.py:41-47)
+    bb_update: bool = False
+    bb_period_T: int = 2
+    bb_alphacorrmin: float = 0.2
+    bb_epsilon: float = 1e-3
+    bb_rhomax: float = 0.1
+
+    # optimizer (the references hardcode Adam lr=1e-3, federated_multi.py:159)
+    lr: float = 1e-3
+
+    # data
+    data_dir: Optional[str] = None
+    drop_last_sample: bool = True  # reference off-by-one parity
+
+    # checkpointing
+    checkpoint_dir: str = "./checkpoints"
+
+    # mesh: None -> use as many devices as divide K
+    num_devices: Optional[int] = None

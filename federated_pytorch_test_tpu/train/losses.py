@@ -1,0 +1,28 @@
+"""Loss functions.
+
+Classifier losses here; VAE / clustering-VAE / CPC losses live with their
+drivers (see train/vae_losses.py and train/cpc_losses.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+
+def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean softmax cross-entropy — torch ``nn.CrossEntropyLoss`` default
+    reduction (federated_multi.py:130-132)."""
+    return jnp.mean(optax.softmax_cross_entropy_with_integer_labels(logits, labels))
+
+
+def l1_l2(x: jnp.ndarray, lambda1: float, lambda2: float) -> jnp.ndarray:
+    """``lambda1 ||x||_1 + lambda2 ||x||_2^2`` on the flat trainable vector
+    (federated_multi.py:183-186)."""
+    return lambda1 * jnp.sum(jnp.abs(x)) + lambda2 * jnp.vdot(x, x)
+
+
+def accuracy_count(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Number of correct top-1 predictions (verification_error_check,
+    federated_multi.py:108-121)."""
+    return jnp.sum(jnp.argmax(logits, axis=-1) == labels)

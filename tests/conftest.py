@@ -15,4 +15,20 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+# The environment may pre-import jax (sitecustomize) with a hardware platform
+# already selected; the env var above is then too late, so force via config.
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", "tests must run on the CPU mesh"
+assert len(jax.devices()) >= 8, (
+    "expected 8 virtual CPU devices; xla_force_host_platform_device_count "
+    "was not honored (jax already initialized its backend?)"
+)
+
 jax.config.update("jax_default_matmul_precision", "float32")
+
+# persistent compilation cache: XLA:CPU compiles dominate test wall-clock;
+# cache them across pytest runs
+_cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

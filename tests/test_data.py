@@ -1,0 +1,86 @@
+"""Data-pipeline tests (reference parity: federated_multi.py:52-85)."""
+
+import numpy as np
+import pytest
+
+from federated_pytorch_test_tpu.data.cifar10 import (
+    FederatedCifar10,
+    client_means,
+    normalize,
+    shard_indices,
+)
+
+
+class TestShardIndices:
+    def test_contiguous_1_over_k_split_with_reference_off_by_one(self):
+        # K_perslave = floor((50000+K-1)/K); exclusive end K_perslave*(ck+1)-1
+        # drops one sample per shard (no_consensus_multi.py:43-46)
+        idx = shard_indices(10, 50000, drop_last_sample=True)
+        assert len(idx) == 10
+        assert all(len(i) == 5000 - 1 for i in idx)
+        assert idx[0][0] == 0 and idx[0][-1] == 4998
+        assert idx[9][0] == 45000 and idx[9][-1] == 49998
+
+    def test_no_drop_variant(self):
+        idx = shard_indices(10, 50000, drop_last_sample=False)
+        assert all(len(i) == 5000 for i in idx)
+        assert np.concatenate(idx).size == 50000
+
+    def test_uneven_k(self):
+        idx = shard_indices(3, 50000, drop_last_sample=False)
+        # K_perslave = floor((50000+2)/3) = 16667; last shard smaller
+        assert len(idx[0]) == 16667 and len(idx[2]) == 50000 - 2 * 16667
+
+
+class TestTransforms:
+    def test_biased_means(self):
+        m = client_means(4, biased_input=True)
+        np.testing.assert_allclose(m[0], [0.5, 0.5, 0.5])
+        np.testing.assert_allclose(m[3], [0.53, 0.47, 0.5], atol=1e-6)
+
+    def test_unbiased_means(self):
+        m = client_means(4, biased_input=False)
+        np.testing.assert_allclose(m, 0.5)
+
+    def test_normalize_range(self):
+        x = np.array([[0, 127.5, 255]], dtype=np.uint8)
+        out = normalize(x, (0.5, 0.5, 0.5))
+        np.testing.assert_allclose(out.ravel(), [-1.0, 0.0, 1.0], atol=0.01)
+
+
+class TestFederatedCifar10:
+    @pytest.fixture(scope="class")
+    def data(self):
+        return FederatedCifar10(K=4, batch=16, limit_per_client=64,
+                               limit_test=64)
+
+    def test_shapes(self, data):
+        xb, yb = data.epoch_batches_raw(seed=0)
+        assert xb.shape == (4, 4, 16, 32, 32, 3) and xb.dtype == np.uint8
+        assert yb.shape == (4, 4, 16) and yb.dtype == np.int32
+
+    def test_epoch_reshuffles(self, data):
+        x0, _ = data.epoch_batches_raw(seed=0)
+        x1, _ = data.epoch_batches_raw(seed=1)
+        assert not np.array_equal(x0, x1)
+
+    def test_test_batches_raw_single_copy(self, data):
+        xt, yt = data.test_batches_raw()
+        assert xt.shape == (4, 16, 32, 32, 3)  # no client axis
+        assert yt.shape == (4, 16)
+
+    def test_disjoint_client_shards(self):
+        d = FederatedCifar10(K=2, batch=8, limit_per_client=32)
+        # clients hold different underlying samples
+        assert not np.array_equal(d._train_x[0], d._train_x[1])
+
+    def test_synthetic_is_deterministic(self):
+        a = FederatedCifar10(K=2, batch=8, limit_per_client=32)
+        b = FederatedCifar10(K=2, batch=8, limit_per_client=32)
+        np.testing.assert_array_equal(a._train_x, b._train_x)
+        np.testing.assert_array_equal(a._test_y, b._test_y)
+
+    def test_float_epoch_batches_normalized(self, data):
+        xb, _ = data.epoch_batches(seed=0)
+        assert xb.dtype == np.float32
+        assert xb.min() >= -1.1 and xb.max() <= 1.1
