@@ -1,0 +1,19 @@
+"""Entry-point drivers mirroring the reference scripts.
+
+Reference script           -> module here
+-------------------------------------------------------------------
+no_consensus_multi.py      -> drivers.no_consensus_multi
+federated_multi.py         -> drivers.federated_multi
+fedprox_multi.py           -> drivers.fedprox_multi
+consensus_multi.py         -> drivers.consensus_multi
+federated_vae.py           -> drivers.federated_vae
+federated_vae_cl.py        -> drivers.federated_vae_cl
+federated_cpc.py           -> drivers.federated_cpc
+
+The reference configures by editing module constants in-source
+(federated_multi.py:9-48); here the same knobs (same names) are CLI flags
+with the reference's defaults, e.g.::
+
+    python -m federated_pytorch_test_tpu.drivers.federated_multi \
+        --K 8 --use-resnet --Nloop 12
+"""

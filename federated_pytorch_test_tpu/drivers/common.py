@@ -1,0 +1,114 @@
+"""Shared CLI plumbing for the classifier drivers.
+
+Factors out the ~120-line skeleton the reference duplicates across its six
+CIFAR scripts (SURVEY.md "Shared driver skeleton"): flags, data partition,
+model choice, common init, engine construction, final checkpoint.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+from typing import Optional
+
+from federated_pytorch_test_tpu.data.cifar10 import FederatedCifar10
+from federated_pytorch_test_tpu.models.resnet import ResNet18
+from federated_pytorch_test_tpu.models.simple import Net
+from federated_pytorch_test_tpu.train.algorithms import Algorithm
+from federated_pytorch_test_tpu.train.config import FederatedConfig
+from federated_pytorch_test_tpu.train.engine import BlockwiseFederatedTrainer
+from federated_pytorch_test_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+
+def build_parser(defaults: FederatedConfig, prog: str) -> argparse.ArgumentParser:
+    """Argparse over the FederatedConfig fields, reference knob names kept."""
+    p = argparse.ArgumentParser(
+        prog=prog,
+        description="TPU-native federated CIFAR10 driver "
+                    "(reference parity: see module docstring)")
+    for f in dataclasses.fields(FederatedConfig):
+        default = getattr(defaults, f.name)
+        arg = "--" + f.name.replace("_", "-")
+        if f.type == "bool" or isinstance(default, bool):
+            p.add_argument(arg, action=argparse.BooleanOptionalAction,
+                           default=default)
+        elif f.name in ("data_dir", "num_devices"):
+            p.add_argument(arg, default=default,
+                           type=str if f.name == "data_dir" else int)
+        else:
+            p.add_argument(arg, type=type(default), default=default)
+    # data-size overrides for smoke runs (not in the reference)
+    p.add_argument("--n-train", type=int, default=None,
+                   help="cap samples per client (smoke tests)")
+    p.add_argument("--n-test", type=int, default=None,
+                   help="cap test-set size (smoke tests)")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> FederatedConfig:
+    kw = {f.name: getattr(args, f.name) for f in dataclasses.fields(FederatedConfig)}
+    return FederatedConfig(**kw)
+
+
+def make_trainer(cfg: FederatedConfig, algorithm: Algorithm,
+                 n_train: Optional[int] = None,
+                 n_test: Optional[int] = None) -> BlockwiseFederatedTrainer:
+    model = ResNet18() if cfg.use_resnet else Net()
+    data = FederatedCifar10(
+        K=cfg.K, batch=cfg.default_batch, biased_input=cfg.biased_input,
+        drop_last_sample=cfg.drop_last_sample, data_dir=cfg.data_dir,
+        limit_per_client=n_train, limit_test=n_test)
+    return BlockwiseFederatedTrainer(model, cfg, data, algorithm)
+
+
+def checkpoint_path(cfg: FederatedConfig, name: str) -> str:
+    return os.path.join(cfg.checkpoint_dir, name)
+
+
+def finish(trainer: BlockwiseFederatedTrainer, state, name: str, history):
+    """Save the end-of-run checkpoint (reference federated_multi.py:226-233)."""
+    cfg = trainer.cfg
+    if cfg.save_model:
+        meta = {"rounds": len(history)}
+        save_checkpoint(checkpoint_path(cfg, name), state._asdict() | {
+            "opt_state": ()}, meta)  # opt state is per-block; not carried over
+        print(f"saved checkpoint -> {checkpoint_path(cfg, name)}")
+
+
+def maybe_load(trainer: BlockwiseFederatedTrainer, name: str):
+    """Resume model params if --load-model (reference :99-103 restores model
+    state only; we restore params + batch_stats)."""
+    cfg = trainer.cfg
+    state = trainer.init_state()
+    path = checkpoint_path(cfg, name)
+    if cfg.load_model and os.path.isdir(os.path.abspath(path)):
+        restored, _ = load_checkpoint(path, like=None)
+        from federated_pytorch_test_tpu.parallel.mesh import client_sharding
+        import jax
+        csh = client_sharding(trainer.mesh)
+        params = jax.tree.map(lambda x: jax.device_put(x, csh), restored["params"])
+        bstats = jax.tree.map(lambda x: jax.device_put(x, csh),
+                              restored["batch_stats"])
+        state = state._replace(params=params, batch_stats=bstats)
+        print(f"loaded checkpoint <- {path}")
+    return state
+
+
+def run_classifier_driver(prog: str, defaults: FederatedConfig,
+                          algorithm: Algorithm, independent: bool = False,
+                          argv=None):
+    args = build_parser(defaults, prog).parse_args(argv)
+    cfg = config_from_args(args)
+    trainer = make_trainer(cfg, algorithm, args.n_train, args.n_test)
+    print(f"{prog}: K={cfg.K} model={'ResNet18' if cfg.use_resnet else 'Net'} "
+          f"devices={trainer.D} clients/device={trainer.K_local} "
+          f"data={trainer.data.source}")
+    state = maybe_load(trainer, prog)
+    if independent:
+        state, history = trainer.run_independent(state)
+    else:
+        state, history = trainer.run(state)
+    print("Finished Training")
+    finish(trainer, state, prog, history)
+    return state, history

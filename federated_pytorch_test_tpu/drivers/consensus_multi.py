@@ -1,0 +1,22 @@
+"""ADMM consensus with optional Barzilai-Borwein adaptive rho.
+
+Reference: consensus_multi.py (K=10, Nloop=12, Nepoch=1, Nadmm=5,
+admm_rho0=0.1, bb_update=False default, biased_input=True).  Clients are
+never reset to z — consensus only via the augmented-Lagrangian penalty.
+"""
+
+from federated_pytorch_test_tpu.drivers.common import run_classifier_driver
+from federated_pytorch_test_tpu.train.algorithms import AdmmConsensus
+from federated_pytorch_test_tpu.train.config import FederatedConfig
+
+DEFAULTS = FederatedConfig(K=10, Nloop=12, Nepoch=1, Nadmm=5,
+                           admm_rho0=0.1, biased_input=True)
+
+
+def main(argv=None):
+    return run_classifier_driver("consensus_multi", DEFAULTS, AdmmConsensus(),
+                                 argv=argv)
+
+
+if __name__ == "__main__":
+    main()

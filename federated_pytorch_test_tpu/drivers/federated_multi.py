@@ -1,0 +1,21 @@
+"""Blockwise FedAvg: average the active block, write z back to every client.
+
+Reference: federated_multi.py (K=10, Nloop=12, Nepoch=1, Nadmm=3,
+lambda1=lambda2=1e-4, Adam lr=1e-3, biased_input=True).
+"""
+
+from federated_pytorch_test_tpu.drivers.common import run_classifier_driver
+from federated_pytorch_test_tpu.train.algorithms import FedAvg
+from federated_pytorch_test_tpu.train.config import FederatedConfig
+
+DEFAULTS = FederatedConfig(K=10, Nloop=12, Nepoch=1, Nadmm=3,
+                           biased_input=True)
+
+
+def main(argv=None):
+    return run_classifier_driver("federated_multi", DEFAULTS, FedAvg(),
+                                 argv=argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""FedProx: proximal term (rho/2)||x-z||^2 in the local loss; z never
+written back (the reference's comment "master will send z to all slaves"
+is aspirational — no put_trainable_values exists, fedprox_multi.py:227).
+
+Reference: fedprox_multi.py (K=10, Nloop=12, Nepoch=1, Nadmm=5,
+admm_rho0=1.0 — the FedProx 'mu', biased_input=True).
+"""
+
+from federated_pytorch_test_tpu.drivers.common import run_classifier_driver
+from federated_pytorch_test_tpu.train.algorithms import FedProx
+from federated_pytorch_test_tpu.train.config import FederatedConfig
+
+DEFAULTS = FederatedConfig(K=10, Nloop=12, Nepoch=1, Nadmm=5,
+                           admm_rho0=1.0, biased_input=True)
+
+
+def main(argv=None):
+    return run_classifier_driver("fedprox_multi", DEFAULTS, FedProx(),
+                                 argv=argv)
+
+
+if __name__ == "__main__":
+    main()

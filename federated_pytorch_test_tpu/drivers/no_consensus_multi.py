@@ -1,0 +1,20 @@
+"""Baseline: K independent models, no parameter exchange ever.
+
+Reference: no_consensus_multi.py (K=10, Nepoch=20, Adam lr=1e-3, Adam
+re-created per epoch, full net trainable, biased_input=True).
+"""
+
+from federated_pytorch_test_tpu.drivers.common import run_classifier_driver
+from federated_pytorch_test_tpu.train.algorithms import NoConsensus
+from federated_pytorch_test_tpu.train.config import FederatedConfig
+
+DEFAULTS = FederatedConfig(K=10, Nepoch=20, biased_input=True)
+
+
+def main(argv=None):
+    return run_classifier_driver("no_consensus_multi", DEFAULTS,
+                                 NoConsensus(), independent=True, argv=argv)
+
+
+if __name__ == "__main__":
+    main()
