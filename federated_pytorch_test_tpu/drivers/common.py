@@ -27,15 +27,23 @@ def build_parser(defaults: FederatedConfig, prog: str) -> argparse.ArgumentParse
         prog=prog,
         description="TPU-native federated CIFAR10 driver "
                     "(reference parity: see module docstring)")
+    # converters for Optional[...] fields (default None carries no type)
+    _optional_types = {"data_dir": str, "num_devices": int}
     for f in dataclasses.fields(FederatedConfig):
         default = getattr(defaults, f.name)
         arg = "--" + f.name.replace("_", "-")
-        if f.type == "bool" or isinstance(default, bool):
+        if isinstance(default, bool):
             p.add_argument(arg, action=argparse.BooleanOptionalAction,
                            default=default)
-        elif f.name in ("data_dir", "num_devices"):
-            p.add_argument(arg, default=default,
-                           type=str if f.name == "data_dir" else int)
+        elif f.name == "optimizer":
+            p.add_argument(arg, choices=("adam", "lbfgs"), default=default)
+        elif default is None:
+            conv = _optional_types.get(f.name)
+            if conv is None:
+                raise TypeError(
+                    f"FederatedConfig.{f.name} has default None; add its "
+                    "converter to _optional_types in drivers/common.py")
+            p.add_argument(arg, type=conv, default=None)
         else:
             p.add_argument(arg, type=type(default), default=default)
     # data-size overrides for smoke runs (not in the reference)
@@ -82,7 +90,7 @@ def maybe_load(trainer: BlockwiseFederatedTrainer, name: str):
     cfg = trainer.cfg
     state = trainer.init_state()
     path = checkpoint_path(cfg, name)
-    if cfg.load_model and os.path.isdir(os.path.abspath(path)):
+    if cfg.load_model and os.path.isdir(os.path.abspath(os.path.expanduser(path))):
         restored, _ = load_checkpoint(path, like=None)
         from federated_pytorch_test_tpu.parallel.mesh import client_sharding
         import jax

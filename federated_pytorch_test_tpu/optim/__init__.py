@@ -1,0 +1,8 @@
+"""Optimizers.
+
+``LBFGSNew`` — jit-compatible stochastic L-BFGS, the TPU-native re-design of
+the reference's custom optimizer (lbfgsnew.py; paper README.md:4,
+ieeexplore 8755567).
+"""
+
+from federated_pytorch_test_tpu.optim.lbfgs import LBFGSNew, LBFGSState  # noqa: F401

@@ -1,0 +1,356 @@
+"""Jit-compatible stochastic L-BFGS (re-design of reference lbfgsnew.py).
+
+The reference optimizer mutates torch parameters in place, keeps Python-list
+curvature history, and runs data-dependent Python line-search loops
+(lbfgsnew.py:124-196, :507-765) — none of which trace under ``jit``
+(SURVEY.md section 7, "Hard parts" #1).  This version is a pure function on a
+*flat parameter vector*:
+
+  * curvature history is a fixed-size circular buffer ``[M, N]`` (static
+    shapes; invalid slots masked in the two-loop recursion);
+  * the inner iteration loop and both backtracking line-search phases are
+    bounded ``lax.while_loop``s with an explicit done-flag for the
+    reference's ``break`` conditions;
+  * the closure is a JAX ``loss_fn(x) -> scalar``; re-evaluations are
+    ``value_and_grad`` calls (the reference pays a full fwd+bwd per closure
+    call; XLA fuses ours into the surrounding computation).
+
+Semantics follow the reference exactly (same constants, same quirks):
+
+  * batch-mode trust region ``y += lm0*s``, lm0=1e-6 (lbfgsnew.py:558-560,
+    :594-595);
+  * batch-change detection ``n_iter==1 and state['n_iter']>1`` (:600);
+  * online inter-batch grad mean/variance -> max step
+    ``alphabar = 1/(1 + Var/((n-1)*||g||))`` (:601-615), where ``||g||`` is
+    the 2-norm of the gradient at *step entry* (the reference's ``grad_nrm``
+    is computed once per ``step()`` and never refreshed — :563);
+  * curvature pairs stored only when ``ys > 1e-10*||s||^2`` and the batch
+    did not change (:618-630);
+  * backtracking line search with Armijo c1=1e-4, <=35 halvings shared
+    across the positive and negative phases, and the negative-step probe
+    when the decrease is below ``|c1*g.d|`` (:124-196);
+  * step-size init ``min(1, 1/sum|g|)*lr`` on the global first iteration,
+    else ``lr`` (:672-675);
+  * convergence tests on max_eval / sum|g| / directional derivative /
+    ``sum|t*d|`` / loss change (:731-747).
+
+The full-batch cubic strong-Wolfe search (lbfgsnew.py:201-504) is not yet
+ported; only ``batch_mode=True`` paths are exercised by the reference's
+active drivers (federated_cpc.py:238-248, federated_vae_cl.py:205).  With
+``line_search_fn=False`` a fixed step ``t`` is used, as in the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class LBFGSState(NamedTuple):
+    """Persistent optimizer state (reference: ``self.state[params[0]]``,
+    lbfgsnew.py:749-762).  All arrays are fixed-shape for jit."""
+
+    n_iter_total: jnp.ndarray      # state['n_iter'] — across step() calls
+    func_evals: jnp.ndarray
+    d: jnp.ndarray                 # [N] last direction
+    t: jnp.ndarray                 # last accepted step size
+    hist_y: jnp.ndarray            # [M, N] circular curvature buffers
+    hist_s: jnp.ndarray            # [M, N]
+    hist_len: jnp.ndarray
+    hist_head: jnp.ndarray         # index of the OLDEST valid entry
+    H_diag: jnp.ndarray
+    prev_grad: jnp.ndarray         # [N]
+    prev_loss: jnp.ndarray
+    running_avg: jnp.ndarray       # [N] inter-batch grad mean (batch mode)
+    running_avg_sq: jnp.ndarray    # [N] accumulated second moment
+    alphabar: jnp.ndarray          # adaptive max step (batch mode)
+
+
+def _dot(a, b):
+    return jnp.vdot(a, b)
+
+
+@dataclasses.dataclass(frozen=True)
+class LBFGSNew:
+    """Stochastic L-BFGS on a flat parameter vector.
+
+    Usage::
+
+        opt = LBFGSNew(history_size=7, max_iter=2, batch_mode=True,
+                       line_search_fn=True)
+        state = opt.init(x0)
+        x, state, loss = opt.step(loss_fn, x, state)   # jittable
+    """
+
+    lr: float = 1.0
+    max_iter: int = 10
+    max_eval: Optional[int] = None
+    tolerance_grad: float = 1e-5
+    tolerance_change: float = 1e-9
+    history_size: int = 7
+    line_search_fn: bool = False
+    batch_mode: bool = False
+
+    def __post_init__(self):
+        if self.line_search_fn and not self.batch_mode:
+            raise NotImplementedError(
+                "full-batch cubic strong-Wolfe line search "
+                "(reference lbfgsnew.py:201-504) is not ported yet; use "
+                "batch_mode=True (backtracking) or line_search_fn=False "
+                "(fixed step)")
+
+    def _max_eval(self) -> int:
+        return self.max_eval if self.max_eval is not None else self.max_iter * 5 // 4
+
+    # ------------------------------------------------------------------
+    def init(self, x: jnp.ndarray) -> LBFGSState:
+        n = x.shape[-1]
+        m = self.history_size
+        f = x.dtype
+        z = lambda *s: jnp.zeros(s, f)
+        return LBFGSState(
+            n_iter_total=jnp.int32(0), func_evals=jnp.int32(0),
+            d=z(n), t=jnp.asarray(self.lr, f),
+            hist_y=z(m, n), hist_s=z(m, n),
+            hist_len=jnp.int32(0), hist_head=jnp.int32(0),
+            H_diag=jnp.asarray(1.0, f),
+            prev_grad=z(n), prev_loss=jnp.asarray(0.0, f),
+            running_avg=z(n), running_avg_sq=z(n),
+            alphabar=jnp.asarray(self.lr, f),
+        )
+
+    # ------------------------------------------------------------------
+    def _two_loop(self, g, hist_y, hist_s, hist_len, head, H_diag):
+        """d = -H*g via the two-loop recursion over the circular buffer
+        (reference lbfgsnew.py:645-659), invalid slots masked out."""
+        M = self.history_size
+
+        def safe_ro(y, s, valid):
+            ys = _dot(y, s)
+            return jnp.where(valid, 1.0 / jnp.where(ys == 0, 1.0, ys), 0.0)
+
+        q = -g
+        al = jnp.zeros((M,), g.dtype)
+
+        def bwd(j, carry):
+            q, al = carry
+            valid = j < hist_len
+            li = hist_len - 1 - j          # logical: newest first
+            pi = (head + li) % M
+            ro = safe_ro(hist_y[pi], hist_s[pi], valid)
+            a = ro * _dot(hist_s[pi], q)
+            a = jnp.where(valid, a, 0.0)
+            return q - a * hist_y[pi], al.at[pi].set(a)
+
+        q, al = lax.fori_loop(0, M, bwd, (q, al))
+        r = H_diag * q
+
+        def fwd(j, r):
+            valid = j < hist_len
+            pi = (head + j) % M            # logical: oldest first
+            ro = safe_ro(hist_y[pi], hist_s[pi], valid)
+            be = ro * _dot(hist_y[pi], r)
+            delta = jnp.where(valid, al[pi] - be, 0.0)
+            return r + delta * hist_s[pi]
+
+        return lax.fori_loop(0, M, fwd, r)
+
+    def _push(self, hist_y, hist_s, hist_len, head, y, s):
+        """Append (y, s); evict the oldest when full (lbfgsnew.py:618-627)."""
+        M = self.history_size
+        full = hist_len == M
+        idx = jnp.where(full, head, (head + hist_len) % M)
+        return (hist_y.at[idx].set(y), hist_s.at[idx].set(s),
+                jnp.where(full, hist_len, hist_len + 1),
+                jnp.where(full, (head + 1) % M, head))
+
+    # ------------------------------------------------------------------
+    def _backtrack(self, value_fn, x, d, g, alphabar, f_old):
+        """Backtracking line search with negative-step probe
+        (reference _linesearch_backtrack, lbfgsnew.py:124-196).
+
+        Returns (alphak, n_value_evals).  ``value_fn`` is loss-only (the
+        reference disables grad during line search, :694-699).
+        """
+        c1 = jnp.asarray(1e-4, x.dtype)
+        citer = 35
+        prodterm = c1 * _dot(g, d)
+
+        def phase(alpha0, ci0):
+            """Halve alpha until Armijo holds or the shared budget runs out."""
+            f0 = value_fn(x + alpha0 * d)
+
+            def cond(c):
+                alpha, f_new, ci = c
+                bad = jnp.isnan(f_new) | (f_new > f_old + alpha * prodterm)
+                return (ci < citer) & bad
+
+            def body(c):
+                alpha, _, ci = c
+                alpha = 0.5 * alpha
+                return alpha, value_fn(x + alpha * d), ci + 1
+
+            return lax.while_loop(cond, body, (alpha0, f0, ci0))
+
+        alphak, f_new, ci = phase(alphabar, jnp.int32(0))
+
+        def neg_probe(args):
+            alphak, f_new, ci = args
+            alphak1, f_new1, ci = phase(-alphabar, ci)
+            take_neg = f_new1 < f_new
+            return jnp.where(take_neg, alphak1, alphak), ci
+
+        def no_probe(args):
+            alphak, _, ci = args
+            return alphak, ci
+
+        alphak, ci = lax.cond(
+            f_old - f_new < jnp.abs(prodterm), neg_probe, no_probe,
+            (alphak, f_new, ci))
+        return alphak, ci
+
+    # ------------------------------------------------------------------
+    def step(self, loss_fn: Callable[[jnp.ndarray], jnp.ndarray],
+             x: jnp.ndarray, state: LBFGSState
+             ) -> Tuple[jnp.ndarray, LBFGSState, jnp.ndarray]:
+        """One optimization step (reference ``step(closure)``,
+        lbfgsnew.py:507-765).  Jittable; ``loss_fn`` must be pure."""
+        cfg = self
+        vg = jax.value_and_grad(loss_fn)
+        dt = x.dtype
+        lm0 = jnp.asarray(1e-6, dt)
+        lr = jnp.asarray(cfg.lr, dt)
+
+        loss0, g0 = vg(x)                       # closure #1 (:536)
+        abs_sum0 = jnp.sum(jnp.abs(g0))
+        grad_nrm = jnp.linalg.norm(g0)          # step-entry norm (:563)
+
+        # alphabar resets to lr at every step() entry (:557-558); only the
+        # running mean/variance persists across steps
+        st = state._replace(func_evals=state.func_evals + 1,
+                            alphabar=jnp.asarray(cfg.lr, dt))
+
+        # carry: x, g, loss, abs_grad_sum, n_iter, evals, done + state fields
+        Carry = Tuple
+        def cond(c):
+            (x, g, loss, abs_sum, n_iter, evals, done, st) = c
+            return (n_iter < cfg.max_iter) & ~done & ~jnp.isnan(grad_nrm)
+
+        def body(c):
+            (x, g, loss, abs_sum, n_iter, evals, done, st) = c
+            n_iter = n_iter + 1
+            total = st.n_iter_total + 1
+
+            # ---- direction (:566-659)
+            first = total == 1
+
+            def first_dir(_):
+                return (-g, st.hist_y * 0, st.hist_s * 0, jnp.int32(0),
+                        jnp.int32(0), jnp.asarray(1.0, dt),
+                        st.running_avg * 0, st.running_avg_sq * 0, st.alphabar)
+
+            def lbfgs_dir(_):
+                y = g - st.prev_grad
+                s = st.d * st.t
+                if cfg.batch_mode:
+                    y = y + lm0 * s             # trust region (:594-595)
+                ys = _dot(y, s)
+                sn2 = _dot(s, s)
+                batch_changed = jnp.asarray(
+                    cfg.batch_mode, bool) & (n_iter == 1) & (total > 1)
+
+                # online inter-batch grad mean/variance (:601-615)
+                def upd_stats(_):
+                    g_old = g - st.running_avg
+                    avg = st.running_avg + g_old / total.astype(dt)
+                    g_new = g - avg
+                    avg_sq = st.running_avg_sq + g_new * g_old
+                    alphabar = 1.0 / (1.0 + jnp.sum(avg_sq)
+                                      / ((total - 1).astype(dt) * grad_nrm))
+                    return avg, avg_sq, alphabar
+
+                def keep_stats(_):
+                    return st.running_avg, st.running_avg_sq, st.alphabar
+
+                avg, avg_sq, alphabar = lax.cond(
+                    batch_changed, upd_stats, keep_stats, None)
+
+                # curvature-pair memory (:618-630)
+                store = (ys > 1e-10 * sn2) & ~batch_changed
+
+                def do_push(_):
+                    hy, hs, hl, hh = self._push(
+                        st.hist_y, st.hist_s, st.hist_len, st.hist_head, y, s)
+                    return hy, hs, hl, hh, ys / _dot(y, y)
+
+                def no_push(_):
+                    return (st.hist_y, st.hist_s, st.hist_len, st.hist_head,
+                            st.H_diag)
+
+                hy, hs, hl, hh, H_diag = lax.cond(store, do_push, no_push, None)
+                d = self._two_loop(g, hy, hs, hl, hh, H_diag)
+                return d, hy, hs, hl, hh, H_diag, avg, avg_sq, alphabar
+
+            d, hy, hs, hl, hh, H_diag, avg, avg_sq, alphabar = lax.cond(
+                first, first_dir, lbfgs_dir, None)
+
+            prev_grad, prev_loss = g, loss
+
+            # ---- step length (:672-675)
+            t = jnp.where(first,
+                          jnp.minimum(jnp.asarray(1.0, dt), 1.0 / abs_sum) * lr,
+                          lr)
+            gtd = _dot(g, d)
+
+            ls_evals = jnp.int32(0)
+            if cfg.line_search_fn and cfg.batch_mode:
+                t_ls, n_ls = self._backtrack(loss_fn, x, d, g, alphabar, loss)
+                t = jnp.where(jnp.isnan(t_ls), lr, t_ls)   # (:701-703)
+                ls_evals = n_ls
+            # (full-batch cubic search not yet ported; fixed t otherwise)
+
+            x = x + t * d                                   # _add_grad (:704)
+
+            # ---- re-eval unless last inner iteration (:713-721)
+            last = n_iter == cfg.max_iter
+
+            def reval(_):
+                l2, g2 = vg(x)
+                return l2, g2, jnp.sum(jnp.abs(g2)), jnp.int32(1)
+
+            def keep(_):
+                return loss, g, abs_sum, jnp.int32(0)
+
+            loss, g, abs_sum, re = lax.cond(last, keep, reval, None)
+            # the max_eval budget counts only closure re-evals (reference
+            # current_evals, :544, :727-729); line-search trials are tracked
+            # in func_evals stats only (:195)
+            evals = evals + re
+
+            # ---- break conditions (:731-747)
+            done = (jnp.isnan(abs_sum)
+                    | (evals >= cfg._max_eval())
+                    | (abs_sum <= cfg.tolerance_grad)
+                    | (gtd > -cfg.tolerance_change)
+                    | (jnp.sum(jnp.abs(t * d)) <= cfg.tolerance_change)
+                    | (jnp.abs(loss - prev_loss) < cfg.tolerance_change))
+
+            st = LBFGSState(
+                n_iter_total=total,
+                func_evals=st.func_evals + 1 + re + ls_evals,
+                d=d, t=t, hist_y=hy, hist_s=hs, hist_len=hl, hist_head=hh,
+                H_diag=H_diag, prev_grad=prev_grad,
+                prev_loss=jnp.asarray(prev_loss, dt),
+                running_avg=avg, running_avg_sq=avg_sq, alphabar=alphabar)
+            return (x, g, loss, abs_sum, n_iter, evals, done, st)
+
+        init = (x, g0, loss0, abs_sum0, jnp.int32(0), jnp.int32(1),
+                abs_sum0 <= cfg.tolerance_grad, st)
+        x, g, loss, abs_sum, n_iter, evals, done, st = lax.while_loop(
+            cond, body, init)
+        # reference returns the loss of the FIRST closure call (:536, :765)
+        return x, st, loss0

@@ -50,8 +50,13 @@ class FederatedConfig:
     bb_epsilon: float = 1e-3
     bb_rhomax: float = 0.1
 
-    # optimizer (the references hardcode Adam lr=1e-3, federated_multi.py:159)
+    # optimizer (the references hardcode Adam lr=1e-3, federated_multi.py:159;
+    # the commented-out alternative is LBFGSNew(history_size=10, max_iter=4,
+    # line_search_fn=True, batch_mode=True), federated_multi.py:158)
+    optimizer: str = "adam"        # "adam" | "lbfgs"
     lr: float = 1e-3
+    lbfgs_history_size: int = 10
+    lbfgs_max_iter: int = 4
 
     # data
     data_dir: Optional[str] = None

@@ -34,6 +34,7 @@ from jax.sharding import PartitionSpec as P
 
 from federated_pytorch_test_tpu.data.cifar10 import FederatedCifar10
 from federated_pytorch_test_tpu.models.base import BlockModule
+from federated_pytorch_test_tpu.optim.lbfgs import LBFGSNew
 from federated_pytorch_test_tpu.parallel.mesh import (
     CLIENT_AXIS,
     client_mesh,
@@ -189,17 +190,53 @@ class BlockwiseFederatedTrainer:
             return loss, new_bs
 
         grad_fn = jax.value_and_grad(batch_loss, has_aux=True)
+        if cfg.optimizer not in ("adam", "lbfgs"):
+            raise ValueError(f"unknown optimizer {cfg.optimizer!r}; "
+                             "expected 'adam' or 'lbfgs'")
+        use_lbfgs = cfg.optimizer == "lbfgs"
+        if use_lbfgs and has_bn:
+            raise ValueError(
+                "lbfgs local optimizer requires a BatchNorm-free model "
+                "(closure re-evaluation with mutable stats is ill-defined; "
+                "the reference only pairs LBFGSNew with BN-free models)")
+        lbfgs = LBFGSNew(history_size=cfg.lbfgs_history_size,
+                         max_iter=cfg.lbfgs_max_iter,
+                         line_search_fn=True, batch_mode=True)
+
+        def adam_step(carry, batch):
+            p, bs, os = carry
+            xb_u8, yb, z, y, rho, mean = batch
+            xb = _normalize_u8(xb_u8, mean)
+            (loss, new_bs), g = grad_fn(p, bs, xb, yb, z, y, rho)
+            g = mask_grads(g)
+            updates, os = tx.update(g, os, p)
+            p = optax.apply_updates(p, updates)
+            return (p, new_bs, os), loss
+
+        def lbfgs_step(carry, batch):
+            # the reference pairs LBFGSNew with a closure re-evaluating the
+            # local loss (federated_multi.py:158, federated_cpc.py:238-248);
+            # here the closure is a pure flat-vector objective on the active
+            # block and step() runs bounded line searches inside jit
+            p, bs, os = carry
+            xb_u8, yb, z, y, rho, mean = batch
+            xb = _normalize_u8(xb_u8, mean)
+
+            def flat_loss(v):
+                pv = codec.put_trainable_values(p, order, mask, v)
+                loss, _ = batch_loss(pv, bs, xb, yb, z, y, rho)
+                return loss
+
+            xflat = codec.get_trainable_values(p, order, mask)
+            xnew, os, loss = lbfgs.step(flat_loss, xflat, os)
+            return (codec.put_trainable_values(p, order, mask, xnew), bs, os), loss
+
+        local_step = lbfgs_step if use_lbfgs else adam_step
 
         def per_client_epoch(p, bs, os, y, mean, xb_u8, yb, z, rho):
             def step(carry, batch):
-                p, bs, os = carry
                 xb_u8, yb = batch
-                xb = _normalize_u8(xb_u8, mean)
-                (loss, new_bs), g = grad_fn(p, bs, xb, yb, z, y, rho)
-                g = mask_grads(g)
-                updates, os = tx.update(g, os, p)
-                p = optax.apply_updates(p, updates)
-                return (p, new_bs, os), loss
+                return local_step(carry, (xb_u8, yb, z, y, rho, mean))
             (p, bs, os), losses = lax.scan(step, (p, bs, os), (xb_u8, yb))
             return p, bs, os, jnp.sum(losses)
 
@@ -260,6 +297,11 @@ class BlockwiseFederatedTrainer:
             )
 
         def init_opt(params):
+            if use_lbfgs:
+                return jax.vmap(
+                    lambda p: lbfgs.init(
+                        codec.get_trainable_values(p, order, mask))
+                )(params)
             return jax.vmap(tx.init)(params)
         init_opt = jax.jit(
             shard_map(init_opt, mesh=self.mesh, in_specs=(spec_c,),

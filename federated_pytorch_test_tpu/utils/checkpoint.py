@@ -3,9 +3,11 @@
 The reference saves one torch file per client at end of run
 (``./s<k>.model`` with model + optimizer state dicts, epoch, running_loss —
 federated_multi.py:226-233) and on resume restores the model state only
-(optimizer state saved but never restored, :99-103 — a quirk we improve on:
-here the whole stacked client pytree round-trips, optimizer state included,
-actually resumable mid-run).
+(optimizer state saved but never restored, :99-103).  This module can
+round-trip ANY pytree (optimizer state included); the stock drivers mirror
+the reference's end-of-run behaviour — params + batch_stats only, since
+per-block optimizer state is recreated at every block switch anyway
+(federated_multi.py:156-159).
 
 TPU-native design: the K clients are ONE sharded pytree (client axis on the
 mesh), so a checkpoint is one orbax directory holding the stacked params /

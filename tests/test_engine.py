@@ -207,6 +207,16 @@ class TestIndependent:
         assert hist[0]["accuracy"].shape == (K,)
 
 
+class TestLbfgsLocalOptimizer:
+    def test_fedavg_with_lbfgs(self, data):
+        cfg = small_cfg(Nadmm=1, optimizer="lbfgs", lbfgs_history_size=5,
+                        lbfgs_max_iter=2)
+        t = BlockwiseFederatedTrainer(Net(), cfg, data, FedAvg())
+        state, hist = t.run(log=lambda m: None)
+        assert all(np.isfinite(h["dual_residual"]) for h in hist)
+        assert all(np.isfinite(h["loss"]) for h in hist)
+
+
 class TestCommonInit:
     def test_all_clients_start_identical(self, data):
         t = BlockwiseFederatedTrainer(Net(), small_cfg(), data, FedAvg())

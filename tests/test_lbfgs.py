@@ -1,0 +1,167 @@
+"""LBFGSNew tests: convex probes, Rosenbrock, stochastic mode, jit/vmap.
+
+Mirrors SURVEY.md section 4's optimizer test strategy (the reference ships
+no tests; validation here is on closed-form objectives).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from federated_pytorch_test_tpu.optim import LBFGSNew
+
+
+def quad_loss(A, b):
+    return lambda x: 0.5 * x @ A @ x - b @ x
+
+
+class TestFullBatchFixedStep:
+    def test_quadratic_converges(self):
+        # well-conditioned SPD quadratic; minimum at A^-1 b
+        rng = np.random.default_rng(0)
+        Q = rng.normal(size=(8, 8))
+        A = jnp.asarray(Q @ Q.T + 8 * np.eye(8), jnp.float32)
+        b = jnp.asarray(rng.normal(size=8), jnp.float32)
+        opt = LBFGSNew(lr=0.05, max_iter=50, history_size=7)
+        x = jnp.zeros(8)
+        st = opt.init(x)
+        for _ in range(10):
+            x, st, loss = opt.step(quad_loss(A, b), x, st)
+        x_star = jnp.linalg.solve(A, b)
+        np.testing.assert_allclose(np.asarray(x), np.asarray(x_star),
+                                   atol=2e-2)
+
+    def test_loss_returned_is_entry_loss(self):
+        A = jnp.eye(2)
+        b = jnp.zeros(2)
+        opt = LBFGSNew(lr=0.1, max_iter=5)
+        x0 = jnp.ones(2)
+        st = opt.init(x0)
+        _, _, loss = opt.step(quad_loss(A, b), x0, st)
+        # reference returns orig_loss — f at step entry (lbfgsnew.py:536,:765)
+        np.testing.assert_allclose(float(loss), 1.0, rtol=1e-6)
+
+
+class TestBatchModeLineSearch:
+    def opt(self, **kw):
+        base = dict(history_size=7, max_iter=4, batch_mode=True,
+                    line_search_fn=True)
+        base.update(kw)
+        return LBFGSNew(**base)
+
+    def test_quadratic_with_line_search(self):
+        rng = np.random.default_rng(1)
+        Q = rng.normal(size=(12, 12))
+        A = jnp.asarray(Q @ Q.T + 12 * np.eye(12), jnp.float32)
+        b = jnp.asarray(rng.normal(size=12), jnp.float32)
+        opt = self.opt()
+        x = jnp.zeros(12)
+        st = opt.init(x)
+        f = quad_loss(A, b)
+        for _ in range(15):
+            x, st, _ = opt.step(f, x, st)
+        x_star = jnp.linalg.solve(A, b)
+        np.testing.assert_allclose(np.asarray(x), np.asarray(x_star), atol=1e-2)
+
+    def test_rosenbrock_descends(self):
+        def rosen(x):
+            return (100.0 * (x[1] - x[0] ** 2) ** 2 + (1 - x[0]) ** 2)
+
+        opt = self.opt(max_iter=10)
+        x = jnp.asarray([-1.2, 1.0], jnp.float32)
+        st = opt.init(x)
+        f0 = float(rosen(x))
+        for _ in range(30):
+            x, st, _ = opt.step(rosen, x, st)
+        # batch mode treats every step() boundary as a batch change
+        # (reference FIXME at lbfgsnew.py:599), so curvature pairs are
+        # discarded there and progress on a static objective is damped —
+        # expect a solid decrease, not superlinear convergence
+        assert float(rosen(x)) < f0 * 0.2
+        assert np.all(np.isfinite(np.asarray(x)))
+
+    def test_stochastic_least_squares(self):
+        # different minibatch objective per step: the batch-changed path and
+        # alphabar machinery must keep the trajectory stable
+        rng = np.random.default_rng(2)
+        w_true = rng.normal(size=6).astype(np.float32)
+        X = rng.normal(size=(256, 6)).astype(np.float32)
+        yv = X @ w_true
+        opt = self.opt(max_iter=2, history_size=5)
+        w = jnp.zeros(6)
+        st = opt.init(w)
+        for i in range(40):
+            sl = slice((i * 32) % 256, (i * 32) % 256 + 32)
+            Xb, yb = jnp.asarray(X[sl]), jnp.asarray(yv[sl])
+            f = lambda w: jnp.mean((Xb @ w - yb) ** 2)
+            w, st, _ = opt.step(f, w, st)
+        np.testing.assert_allclose(np.asarray(w), w_true, atol=5e-2)
+
+    def test_history_eviction(self):
+        # more steps than history_size on a single objective: hist_len caps
+        A = jnp.eye(4) * 2
+        b = jnp.ones(4)
+        opt = LBFGSNew(history_size=3, max_iter=2, batch_mode=True,
+                       line_search_fn=True)
+        x = jnp.zeros(4)
+        st = opt.init(x)
+        f = quad_loss(A, b)
+        for _ in range(10):
+            x, st, _ = opt.step(f, x, st)
+        assert int(st.hist_len) <= 3
+
+    def test_nan_loss_falls_back(self):
+        # objective NaN away from origin: line search halves into range and
+        # the optimizer must not produce NaN params
+        def f(x):
+            v = jnp.sum(x ** 2)
+            return jnp.where(v > 1.0, jnp.nan, v)
+
+        opt = self.opt(max_iter=2)
+        x = jnp.asarray([0.1, 0.1], jnp.float32)
+        st = opt.init(x)
+        for _ in range(3):
+            x, st, _ = opt.step(f, x, st)
+        assert np.all(np.isfinite(np.asarray(x)))
+
+
+class TestJitAndVmap:
+    def test_step_is_jittable(self):
+        A = jnp.eye(3)
+        b = jnp.ones(3)
+        opt = LBFGSNew(max_iter=3, batch_mode=True, line_search_fn=True)
+        f = quad_loss(A, b)
+        step = jax.jit(lambda x, st: opt.step(f, x, st))
+        x = jnp.zeros(3)
+        st = opt.init(x)
+        for _ in range(5):
+            x, st, loss = step(x, st)
+        np.testing.assert_allclose(np.asarray(x), np.ones(3), atol=1e-3)
+
+    def test_vmap_over_clients(self):
+        # K independent optimizers advanced in lockstep — the engine's usage
+        K, N = 4, 5
+        rng = np.random.default_rng(3)
+        bs = jnp.asarray(rng.normal(size=(K, N)), jnp.float32)
+        opt = LBFGSNew(max_iter=2, batch_mode=True, line_search_fn=True)
+
+        def per_client(x, st, b):
+            f = lambda x: 0.5 * jnp.sum(x ** 2) - b @ x
+            return opt.step(f, x, st)
+
+        xs = jnp.zeros((K, N))
+        sts = jax.vmap(opt.init)(xs)
+        stepped = jax.jit(jax.vmap(per_client))
+        for _ in range(8):
+            xs, sts, losses = stepped(xs, sts, bs)
+        np.testing.assert_allclose(np.asarray(xs), np.asarray(bs), atol=1e-2)
+
+    def test_convergence_early_exit(self):
+        # starting at the optimum: step should leave params unchanged
+        opt = LBFGSNew(max_iter=5)
+        x = jnp.ones(3)
+        st = opt.init(x)
+        f = lambda x: jnp.sum((x - 1.0) ** 2)
+        x2, st2, loss = opt.step(f, x, st)
+        np.testing.assert_allclose(np.asarray(x2), np.asarray(x), atol=1e-7)
