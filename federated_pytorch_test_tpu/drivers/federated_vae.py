@@ -1,0 +1,35 @@
+"""Federated VAE: layer-wise FedAvg on AutoEncoderCNN.
+
+Reference: federated_vae.py (K=10, Nloop=12, Nepoch=1, Nadmm=3, Adam lr=1e-3,
+biased_input=True, z written back every round).
+"""
+
+from federated_pytorch_test_tpu.data.cifar10 import FederatedCifar10
+from federated_pytorch_test_tpu.drivers import common
+from federated_pytorch_test_tpu.models.vae import AutoEncoderCNN
+from federated_pytorch_test_tpu.train.algorithms import FedAvg
+from federated_pytorch_test_tpu.train.config import FederatedConfig
+from federated_pytorch_test_tpu.train.vae_engine import VAETrainer
+
+DEFAULTS = FederatedConfig(K=10, Nloop=12, Nepoch=1, Nadmm=3,
+                           biased_input=True, check_results=False)
+
+
+def main(argv=None):
+    args = common.build_parser(DEFAULTS, "federated_vae").parse_args(argv)
+    cfg = common.config_from_args(args)
+    data = FederatedCifar10(
+        K=cfg.K, batch=cfg.default_batch, biased_input=cfg.biased_input,
+        drop_last_sample=cfg.drop_last_sample, data_dir=cfg.data_dir,
+        limit_per_client=args.n_train, limit_test=args.n_test)
+    trainer = VAETrainer(AutoEncoderCNN(), cfg, data, FedAvg())
+    print(f"federated_vae: K={cfg.K} devices={trainer.D} data={data.source}")
+    state = common.maybe_load(trainer, "federated_vae")
+    state, history = trainer.run(state)
+    print("Finished Training")
+    common.finish(trainer, state, "federated_vae", history)
+    return state, history
+
+
+if __name__ == "__main__":
+    main()

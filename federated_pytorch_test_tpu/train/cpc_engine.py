@@ -1,0 +1,207 @@
+"""Federated CPC trainer (reference federated_cpc.py).
+
+Three sub-models (encoder / contextgen / predictor) trained in rotation:
+freeze two, sweep the third's blocks; each communication round runs Niter
+fresh random LOFAR minibatches through LBFGSNew, then FedAvg of the active
+sub-model's block with z written back (federated_cpc.py:194-304).
+
+TPU design mirrors the classifier engine: the K clients are stacked pytrees
+sharded over the 'clients' mesh axis; a round is one jitted shard_map (scan
+over Niter, vmap over local clients, psum for the average).  The host only
+feeds the [K, Niter, nbatch, 32, 32, 8] patch tensor per round.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+from federated_pytorch_test_tpu.data.lofar import CPCDataSource
+from federated_pytorch_test_tpu.models.cpc import (
+    ContextgenCNN,
+    EncoderCNN,
+    PredictorCNN,
+)
+from federated_pytorch_test_tpu.optim.lbfgs import LBFGSNew
+from federated_pytorch_test_tpu.parallel.comm import federated_mean
+from federated_pytorch_test_tpu.parallel.mesh import (
+    CLIENT_AXIS,
+    client_mesh,
+    client_sharding,
+    usable_device_count,
+)
+from federated_pytorch_test_tpu.train.cpc_losses import info_nce
+from federated_pytorch_test_tpu.utils import blocks as blocklib
+from federated_pytorch_test_tpu.utils import codec
+from federated_pytorch_test_tpu.utils.initializers import init_weights
+
+SUBMODELS = ("encoder", "contextgen", "predictor")
+
+
+class CPCState(NamedTuple):
+    """Stacked [K, ...] params of the three sub-models."""
+
+    encoder: Any
+    contextgen: Any
+    predictor: Any
+
+
+class CPCTrainer:
+    """Rotating 3-sub-model federated CPC."""
+
+    def __init__(self, data: CPCDataSource, latent_dim: int = 256,
+                 reduced_dim: int = 32, lbfgs_history: int = 7,
+                 lbfgs_max_iter: int = 2, Niter: int = 10,
+                 init_seed: int = 0, num_devices: Optional[int] = None):
+        self.data = data
+        self.K = data.K
+        self.Niter = Niter
+        self.models = {
+            "encoder": EncoderCNN(latent_dim=latent_dim),
+            "contextgen": ContextgenCNN(latent_dim=latent_dim),
+            "predictor": PredictorCNN(latent_dim=latent_dim,
+                                      reduced_dim=reduced_dim),
+        }
+        self.lbfgs = LBFGSNew(history_size=lbfgs_history,
+                              max_iter=lbfgs_max_iter,
+                              line_search_fn=True, batch_mode=True)
+
+        mesh = client_mesh(num_devices or usable_device_count(self.K))
+        self.mesh = mesh
+        self.D = mesh.devices.size
+        if self.K % self.D:
+            raise ValueError(f"K={self.K} not divisible by {self.D} devices")
+
+        # common init (reference seeds all K identically,
+        # federated_cpc.py:184-189)
+        rng = jax.random.PRNGKey(init_seed)
+        ps = data.patch_size
+        sample = jnp.zeros((1, ps, ps, 8), jnp.float32)
+        enc_p, _ = self.models["encoder"].init_variables(rng, sample)
+        lat = jnp.zeros((1, 2, 2, latent_dim), jnp.float32)
+        ctx_p, _ = self.models["contextgen"].init_variables(rng, lat)
+        pred_p, _ = self.models["predictor"].init_variables(rng, lat, lat)
+        params = {"encoder": enc_p, "contextgen": ctx_p, "predictor": pred_p}
+        params = {k: init_weights(v, jax.random.PRNGKey(init_seed))
+                  for k, v in params.items()}
+
+        csh = client_sharding(mesh)
+        stack = lambda t: jax.tree.map(
+            lambda v: jnp.broadcast_to(v[None], (self.K,) + v.shape), t)
+        self.state0 = CPCState(**{k: jax.device_put(stack(v), csh)
+                                  for k, v in params.items()})
+        self._fn_cache: Dict[Any, Any] = {}
+
+    # ------------------------------------------------------------------
+    def _forward(self, enc_p, ctx_p, pred_p, y, px: int, py: int):
+        """Encoder -> grid reshape -> contextgen -> predictor -> InfoNCE
+        (reference closure, federated_cpc.py:255-276)."""
+        latents = self.models["encoder"].apply({"params": enc_p}, y)
+        B = y.shape[0] // (px * py)
+        grid = latents.reshape(B, px, py, -1)           # NHWC grid
+        context = self.models["contextgen"].apply({"params": ctx_p}, grid)
+        reduced, pred = self.models["predictor"].apply(
+            {"params": pred_p}, grid, context)
+        return info_nce(reduced, pred)
+
+    def _build_round(self, mdl: str, ci: int, px: int, py: int):
+        """Jitted (train Niter batches + fedavg + writeback) for one
+        (sub-model, block)."""
+        key = (mdl, ci, px, py)
+        if key in self._fn_cache:
+            return self._fn_cache[key]
+
+        model = self.models[mdl]
+        order = model.param_order()
+        block = model.train_order_block_ids()[ci]
+        sub0 = getattr(self.state0, mdl)
+        one = jax.tree.map(lambda x: x[0], sub0)
+        mask = blocklib.build_mask(
+            jax.tree.map(lambda _: 0, one),
+            blocklib.block_paths(order, block))
+        N = codec.masked_size(one, order, mask)
+        lbfgs = self.lbfgs
+        K = self.K
+        fwd = self._forward
+
+        def per_client(enc_p, ctx_p, pred_p, ys):
+            sub = {"encoder": enc_p, "contextgen": ctx_p,
+                   "predictor": pred_p}[mdl]
+            xflat0 = codec.get_trainable_values(sub, order, mask)
+            os0 = lbfgs.init(xflat0)
+
+            def step(carry, y):
+                xflat, os = carry
+
+                def flat_loss(v):
+                    sub_v = codec.put_trainable_values(sub, order, mask, v)
+                    parts = {"encoder": enc_p, "contextgen": ctx_p,
+                             "predictor": pred_p}
+                    parts[mdl] = sub_v
+                    return fwd(parts["encoder"], parts["contextgen"],
+                               parts["predictor"], y, px, py)
+
+                xflat, os, loss = lbfgs.step(flat_loss, xflat, os)
+                return (xflat, os), loss
+
+            (xflat, _), losses = lax.scan(step, (xflat0, os0), ys)
+            return xflat, jnp.sum(losses)
+
+        def round_shard(state: CPCState, z, data):
+            # data: [K_local, Niter, nbatch, ps, ps, 8]
+            xflat, losses = jax.vmap(per_client)(
+                state.encoder, state.contextgen, state.predictor, data)
+            znew = federated_mean(xflat, K)               # fedavg (:289-296)
+            dual = jnp.linalg.norm(z - znew) / N          # (:295)
+            sub = getattr(state, mdl)
+            sub = jax.vmap(
+                lambda p: codec.put_trainable_values(p, order, mask, znew)
+            )(sub)                                        # write-back (:299-304)
+            return state._replace(**{mdl: sub}), znew, dual, losses
+
+        spec_c = P(CLIENT_AXIS)
+        spec_r = P()
+        state_spec = CPCState(spec_c, spec_c, spec_c)
+        fn = jax.jit(
+            shard_map(round_shard, mesh=self.mesh,
+                      in_specs=(state_spec, spec_r, spec_c),
+                      out_specs=(state_spec, spec_r, spec_r, spec_c),
+                      check_vma=False))
+        self._fn_cache[key] = (fn, N)
+        return self._fn_cache[key]
+
+    # ------------------------------------------------------------------
+    def run(self, Nloop: int = 1, Nadmm: int = 1,
+            state: Optional[CPCState] = None,
+            log: Callable[[str], None] = print):
+        """The rotation loop (federated_cpc.py:194-304)."""
+        state = state or self.state0
+        history: List[Dict[str, Any]] = []
+        csh = client_sharding(self.mesh)
+        for nloop in range(Nloop):
+            for mdl in SUBMODELS:
+                blocks = self.models[mdl].train_order_block_ids()
+                for ci in range(len(blocks)):
+                    z = None
+                    for nadmm in range(Nadmm):
+                        px, py, batch = self.data.round_batches(self.Niter)
+                        fn, N = self._build_round(mdl, ci, px, py)
+                        if z is None:
+                            z = jnp.zeros((N,), jnp.float32)
+                        state, z, dual, losses = fn(
+                            state, z, jax.device_put(batch, csh))
+                        rec = dict(nloop=nloop, model=mdl, block=ci,
+                                   nadmm=nadmm, N=N,
+                                   dual_residual=float(dual),
+                                   loss=float(np.sum(np.asarray(losses))))
+                        history.append(rec)
+                        log(f"dual (N={N},loop={nloop},model={mdl},"
+                            f"block={ci},avg={nadmm})={rec['dual_residual']:e} "
+                            f"loss={rec['loss']:e}")
+        return state, history

@@ -1,0 +1,30 @@
+"""InfoNCE loss for CPC (reference federated_cpc.py:149-180).
+
+The reference builds the (P x P) normalized inner-product matrix with nested
+Python loops over patch positions — O(P^2) separate torch ops.  Here it is
+one matmul + a log-softmax-style reduction: identical math, MXU-shaped.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.scipy.special import logsumexp
+
+
+def info_nce(z: jnp.ndarray, zhat: jnp.ndarray) -> jnp.ndarray:
+    """z, zhat: [B, px, py, R] (NHWC; the reference is [B, C, px, py]).
+
+    Columns are patch positions: Z[:, p] stacks (batch x channel) values of
+    position p.  zz[i, j] = <Z[:,i], Zhat[:,j]> / (||Z[:,i]|| ||Zhat[:,j]||);
+    positives on the diagonal; loss = -sum_i log(softmax_row_i[i] + 1e-6)
+    (the reference adds 1e-6 inside the log, federated_cpc.py:178).
+    """
+    B, px, py, R = z.shape
+    P = px * py
+    Z = z.transpose(0, 3, 1, 2).reshape(-1, P)
+    Zhat = zhat.transpose(0, 3, 1, 2).reshape(-1, P)
+    zn = jnp.linalg.norm(Z, axis=0)          # [P]
+    zhn = jnp.linalg.norm(Zhat, axis=0)      # [P]
+    zz = (Z.T @ Zhat) / (zn[:, None] * zhn[None, :])
+    log_p = jnp.diag(zz) - logsumexp(zz, axis=1)
+    return -jnp.sum(jnp.log(jnp.exp(log_p) + 1e-6))

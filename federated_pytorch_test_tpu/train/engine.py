@@ -71,7 +71,22 @@ def _normalize_u8(x_u8: jnp.ndarray, mean: jnp.ndarray) -> jnp.ndarray:
 
 class BlockwiseFederatedTrainer:
     """Shared engine for the classifier drivers (no_consensus / fedavg /
-    fedprox / consensus); the VAE/CPC drivers reuse its building blocks."""
+    fedprox / consensus).  The VAE / clustering-VAE trainers subclass it and
+    override the hook methods (``model_loss``, ``sweep_paths``,
+    ``optimizer_for_block``, ...) — the reference instead copy-pastes the
+    whole driver skeleton per workload (SURVEY.md "Shared driver skeleton").
+    """
+
+    #: "blocks" sweeps train_order_block_ids() (federated_multi.py:145-147);
+    #: "layers" sweeps (weight, bias) pairs — the VAE driver's
+    #: unfreeze_one_layer path (federated_vae.py:129)
+    sweep: str = "blocks"
+    #: whether model_loss consumes a PRNG key (VAE reparametrisation)
+    needs_rng: bool = False
+
+    def sample_init_args(self):
+        """Args after rng for ``model.init`` (overridden by rng-taking models)."""
+        return (jnp.zeros((1, 32, 32, 3), jnp.float32),)
 
     def __init__(
         self,
@@ -91,7 +106,13 @@ class BlockwiseFederatedTrainer:
         self.order = model.param_order()
         self.block_ids = model.train_order_block_ids()
         self.linear_ids = model.linear_layer_ids()
-        self.L = len(self.block_ids)
+        if self.sweep == "layers":
+            # reference quirk preserved: the VAE driver iterates ci over
+            # range(len(train_order_block_ids())) but freezes LAYER ci
+            # (federated_vae.py:126-129), so L is still the block count
+            self.L = len(self.block_ids)
+        else:
+            self.L = len(self.block_ids)
 
         K = cfg.K
         if mesh is None:
@@ -106,8 +127,7 @@ class BlockwiseFederatedTrainer:
         # (reference seeds torch.manual_seed(0) before init of EVERY client,
         # federated_multi.py:124-128)
         rng = jax.random.PRNGKey(cfg.init_seed)
-        sample = jnp.zeros((1, 32, 32, 3), jnp.float32)
-        params, batch_stats = model.init_variables(rng, sample)
+        params, batch_stats = model.init_variables(rng, *self.sample_init_args())
         if cfg.init_model:
             params = init_weights(params, jax.random.PRNGKey(cfg.init_seed))
         self.has_bn = bool(batch_stats)
@@ -133,22 +153,57 @@ class BlockwiseFederatedTrainer:
         )
 
     # ------------------------------------------------------------------
-    # masks / per-block plumbing
+    # masks / per-block plumbing (hooks overridable by workload subclasses)
     # ------------------------------------------------------------------
+    def sweep_paths(self, ci: int):
+        """Active leaf paths of sweep unit ``ci``."""
+        if self.sweep == "layers":
+            return blocklib.layer_paths(self.order, ci)
+        return blocklib.block_paths(self.order, self.block_ids[ci])
+
     def mask_for_block(self, ci: Optional[int]):
-        """Leaf mask for block ``ci``; ``None`` -> the whole net."""
-        if ci is None:
-            paths = tuple(self.order)
-        else:
-            paths = blocklib.block_paths(self.order, self.block_ids[ci])
+        """Leaf mask for sweep unit ``ci``; ``None`` -> the whole net."""
+        paths = tuple(self.order) if ci is None else self.sweep_paths(ci)
         return blocklib.build_mask(jax.tree.map(lambda _: 0, self.params0), paths)
 
     def block_size(self, ci: Optional[int]) -> int:
         one = jax.tree.map(lambda x: x[0], self.params0)
         return codec.masked_size(one, self.order, self.mask_for_block(ci))
 
-    def _tx(self):
-        return optax.adam(self.cfg.lr)
+    def optimizer_for_block(self, ci: Optional[int]) -> str:
+        """'adam' | 'lbfgs' — the VAE-CL driver switches per block
+        (federated_vae_cl.py:200-205)."""
+        return self.cfg.optimizer
+
+    def lr_for_block(self, ci: Optional[int]) -> float:
+        return self.cfg.lr
+
+    def reg_for_block(self, ci: Optional[int]):
+        """(lambda1, lambda2) applied to the flat trainable vector.
+
+        Classifier default reproduces the reference quirk: the *block* index
+        is tested against parameter-enumeration ids (federated_multi.py:183).
+        """
+        if ci is not None and ci in self.linear_ids:
+            return (self.cfg.lambda1, self.cfg.lambda2)
+        return (0.0, 0.0)
+
+    def model_loss(self, p, bs, xb, yb, rng):
+        """Per-batch core loss -> (scalar, new_batch_stats).
+
+        Classifier default: CE on logits (federated_multi.py:178-189).
+        Subclasses override for VAE/VAE-CL losses (and set needs_rng).
+        """
+        logits, new_bs = self._apply_train(p, bs, xb)
+        return self.loss_fn(logits, yb), new_bs
+
+    def _apply_train(self, p, bs, xb):
+        if self.has_bn:
+            out, mut = self.model.apply(
+                {"params": p, "batch_stats": bs}, xb, train=True,
+                mutable=["batch_stats"])
+            return out, mut["batch_stats"]
+        return self.model.apply({"params": p}, xb, train=True), bs
 
     # ------------------------------------------------------------------
     # compiled steps (built per block; cached)
@@ -159,41 +214,31 @@ class BlockwiseFederatedTrainer:
         if key in self._fn_cache:
             return self._fn_cache[key]
 
-        cfg, algo, model = self.cfg, self.algo, self.model
+        cfg, algo = self.cfg, self.algo
         order = self.order
         mask = self.mask_for_block(ci)
         mask_grads = functools.partial(blocklib.mask_tree, mask=mask)
-        # reference quirk reproduced: the *block* index is tested against
-        # parameter-enumeration ids (federated_multi.py:183) — see models/base.py
-        reg_on = ci is not None and ci in self.linear_ids
-        tx = self._tx()
+        lam1, lam2 = self.reg_for_block(ci)
+        reg_on = lam1 != 0.0 or lam2 != 0.0
+        opt_name = self.optimizer_for_block(ci)
+        if opt_name not in ("adam", "lbfgs"):
+            raise ValueError(f"unknown optimizer {opt_name!r}; "
+                             "expected 'adam' or 'lbfgs'")
+        use_lbfgs = opt_name == "lbfgs"
+        tx = optax.adam(self.lr_for_block(ci))
         has_bn = self.has_bn
-        loss_fn = self.loss_fn
-        K, K_local = cfg.K, self.K_local
+        model_loss = self.model_loss
+        K = cfg.K
 
-        def apply_train(p, bs, xb):
-            if has_bn:
-                out, mut = model.apply(
-                    {"params": p, "batch_stats": bs}, xb, train=True,
-                    mutable=["batch_stats"],
-                )
-                return out, mut["batch_stats"]
-            return model.apply({"params": p}, xb, train=True), bs
-
-        def batch_loss(p, bs, xb, yb, z, y, rho):
-            logits, new_bs = apply_train(p, bs, xb)
-            loss = loss_fn(logits, yb)
+        def batch_loss(p, bs, xb, yb, rng, z, y, rho):
+            loss, new_bs = model_loss(p, bs, xb, yb, rng)
             xflat = codec.get_trainable_values(p, order, mask)
             loss = loss + algo.penalty(xflat, z, y, rho)
             if reg_on:
-                loss = loss + l1_l2(xflat, cfg.lambda1, cfg.lambda2)
+                loss = loss + l1_l2(xflat, lam1, lam2)
             return loss, new_bs
 
         grad_fn = jax.value_and_grad(batch_loss, has_aux=True)
-        if cfg.optimizer not in ("adam", "lbfgs"):
-            raise ValueError(f"unknown optimizer {cfg.optimizer!r}; "
-                             "expected 'adam' or 'lbfgs'")
-        use_lbfgs = cfg.optimizer == "lbfgs"
         if use_lbfgs and has_bn:
             raise ValueError(
                 "lbfgs local optimizer requires a BatchNorm-free model "
@@ -205,9 +250,9 @@ class BlockwiseFederatedTrainer:
 
         def adam_step(carry, batch):
             p, bs, os = carry
-            xb_u8, yb, z, y, rho, mean = batch
+            xb_u8, yb, rng, z, y, rho, mean = batch
             xb = _normalize_u8(xb_u8, mean)
-            (loss, new_bs), g = grad_fn(p, bs, xb, yb, z, y, rho)
+            (loss, new_bs), g = grad_fn(p, bs, xb, yb, rng, z, y, rho)
             g = mask_grads(g)
             updates, os = tx.update(g, os, p)
             p = optax.apply_updates(p, updates)
@@ -219,12 +264,12 @@ class BlockwiseFederatedTrainer:
             # here the closure is a pure flat-vector objective on the active
             # block and step() runs bounded line searches inside jit
             p, bs, os = carry
-            xb_u8, yb, z, y, rho, mean = batch
+            xb_u8, yb, rng, z, y, rho, mean = batch
             xb = _normalize_u8(xb_u8, mean)
 
             def flat_loss(v):
                 pv = codec.put_trainable_values(p, order, mask, v)
-                loss, _ = batch_loss(pv, bs, xb, yb, z, y, rho)
+                loss, _ = batch_loss(pv, bs, xb, yb, rng, z, y, rho)
                 return loss
 
             xflat = codec.get_trainable_values(p, order, mask)
@@ -233,18 +278,21 @@ class BlockwiseFederatedTrainer:
 
         local_step = lbfgs_step if use_lbfgs else adam_step
 
-        def per_client_epoch(p, bs, os, y, mean, xb_u8, yb, z, rho):
+        def per_client_epoch(p, bs, os, y, mean, key, xb_u8, yb, z, rho):
+            steps = xb_u8.shape[0]
             def step(carry, batch):
-                xb_u8, yb = batch
-                return local_step(carry, (xb_u8, yb, z, y, rho, mean))
-            (p, bs, os), losses = lax.scan(step, (p, bs, os), (xb_u8, yb))
+                xb_u8, yb, i = batch
+                rng = jax.random.fold_in(key, i)
+                return local_step(carry, (xb_u8, yb, rng, z, y, rho, mean))
+            (p, bs, os), losses = lax.scan(
+                step, (p, bs, os), (xb_u8, yb, jnp.arange(steps)))
             return p, bs, os, jnp.sum(losses)
 
-        def epoch_shard(state: ClientState, y, mean, xb_u8, yb, z, rho):
+        def epoch_shard(state: ClientState, y, mean, keys, xb_u8, yb, z, rho):
             p, bs, os, loss = jax.vmap(
-                per_client_epoch, in_axes=(0, 0, 0, 0, 0, 0, 0, None, None)
-            )(state.params, state.batch_stats, state.opt_state, y, mean, xb_u8, yb,
-              z, rho)
+                per_client_epoch, in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None, None)
+            )(state.params, state.batch_stats, state.opt_state, y, mean, keys,
+              xb_u8, yb, z, rho)
             return ClientState(p, bs, os), loss
 
         def comm_shard(state: ClientState, z, y, rho, x0, yhat0, mode):
@@ -277,7 +325,8 @@ class BlockwiseFederatedTrainer:
             shard_map(
                 epoch_shard,
                 mesh=self.mesh,
-                in_specs=(state_specs, spec_c, spec_c, spec_c, spec_c, spec_r, spec_r),
+                in_specs=(state_specs, spec_c, spec_c, spec_c, spec_c, spec_c,
+                          spec_r, spec_r),
                 out_specs=(state_specs, spec_c),
                 check_vma=False,
             )
@@ -329,26 +378,33 @@ class BlockwiseFederatedTrainer:
             )
         return self._fn_cache[key]
 
+    def _apply_eval(self, p, bs, xb):
+        if self.has_bn:
+            return self.model.apply(
+                {"params": p, "batch_stats": bs}, xb, train=False)
+        return self.model.apply({"params": p}, xb, train=False)
+
+    def eval_batch_metric(self, p, bs, xb, yb):
+        """Per-test-batch accumulated metric (classifier: correct count)."""
+        logits = self._apply_eval(p, bs, xb)
+        return accuracy_count(logits, yb).astype(jnp.float32)
+
+    def eval_finalize(self, totals: np.ndarray, n_samples: int) -> np.ndarray:
+        """Classifier: percent accuracy (federated_multi.py:121)."""
+        return 100.0 * totals / n_samples
+
     def _build_eval(self):
         key = ("eval",)
         if key in self._fn_cache:
             return self._fn_cache[key]
-        model, has_bn = self.model, self.has_bn
-
-        def apply_eval(p, bs, xb):
-            if has_bn:
-                return model.apply(
-                    {"params": p, "batch_stats": bs}, xb, train=False
-                )
-            return model.apply({"params": p}, xb, train=False)
+        metric = self.eval_batch_metric
 
         def per_client(p, bs, mean, xt_u8, yt):
-            def step(correct, batch):
+            def step(acc, batch):
                 xb_u8, yb = batch
-                logits = apply_eval(p, bs, _normalize_u8(xb_u8, mean))
-                return correct + accuracy_count(logits, yb), None
-            correct, _ = lax.scan(step, jnp.int32(0), (xt_u8, yt))
-            return correct
+                return acc + metric(p, bs, _normalize_u8(xb_u8, mean), yb), None
+            acc, _ = lax.scan(step, jnp.float32(0), (xt_u8, yt))
+            return acc
 
         def eval_shard(params, batch_stats, mean, xt_u8, yt):
             return jax.vmap(per_client, in_axes=(0, 0, 0, None, None))(
@@ -372,18 +428,26 @@ class BlockwiseFederatedTrainer:
     # host-side driver
     # ------------------------------------------------------------------
     def evaluate(self, state: ClientState) -> np.ndarray:
-        """Per-client top-1 accuracy (%) over the full test set —
-        verification_error_check (federated_multi.py:108-121)."""
+        """Per-client metric over the full test set — classifier default is
+        top-1 accuracy %, verification_error_check (federated_multi.py:108-121)."""
         fn = self._build_eval()
-        correct = fn(state.params, state.batch_stats, self.client_mean,
-                     self.test_x, self.test_y)
+        totals = fn(state.params, state.batch_stats, self.client_mean,
+                    self.test_x, self.test_y)
         total = self.test_y.shape[0] * self.test_y.shape[1]
-        return 100.0 * np.asarray(correct) / total
+        return self.eval_finalize(np.asarray(totals), total)
 
     def _stage_epoch(self):
         xb, yb = self.data.epoch_batches_raw(int(self._shuffle.integers(2**31)))
         sh = client_sharding(self.mesh)
         return jax.device_put(xb, sh), jax.device_put(yb, sh)
+
+    def _epoch_keys(self):
+        """Per-client PRNG keys [K, 2] for this epoch (reparam sampling —
+        replaces torch.cuda.FloatTensor.normal_, simple_models.py:292-301)."""
+        base = jax.random.PRNGKey(int(self._shuffle.integers(2**31)))
+        keys = jax.random.split(base, self.cfg.K)
+        keys = jnp.asarray(jax.random.key_data(keys))
+        return jax.device_put(keys, client_sharding(self.mesh))
 
     def init_state(self) -> ClientState:
         return ClientState(self.params0, self.batch_stats0, None)
@@ -430,7 +494,8 @@ class BlockwiseFederatedTrainer:
                     for _ in range(cfg.Nepoch):
                         xb, yb = self._stage_epoch()
                         state, losses = train_epoch(
-                            state, y, self.client_mean, xb, yb, z, rho)
+                            state, y, self.client_mean, self._epoch_keys(),
+                            xb, yb, z, rho)
                         loss_sum += float(np.sum(np.asarray(losses)))
                     if algo.communicates:
                         if cfg.bb_update and nadmm == 0:
@@ -478,7 +543,8 @@ class BlockwiseFederatedTrainer:
             state = ClientState(state.params, state.batch_stats,
                                 init_opt(state.params))
             xb, yb = self._stage_epoch()
-            state, losses = train_epoch(state, y, self.client_mean, xb, yb, z, rho)
+            state, losses = train_epoch(state, y, self.client_mean,
+                                        self._epoch_keys(), xb, yb, z, rho)
             rec = dict(epoch=epoch, loss=float(np.sum(np.asarray(losses))))
             if cfg.check_results:
                 rec["accuracy"] = self.evaluate(state)

@@ -1,0 +1,100 @@
+"""VAE and clustering-VAE trainers — engine subclasses.
+
+The reference ships these as two more copies of the driver skeleton
+(federated_vae.py, federated_vae_cl.py); here they are small subclasses of
+:class:`BlockwiseFederatedTrainer` overriding the workload hooks.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from federated_pytorch_test_tpu.train.engine import BlockwiseFederatedTrainer
+from federated_pytorch_test_tpu.train.vae_losses import vae_cl_loss, vae_loss
+
+
+class VAETrainer(BlockwiseFederatedTrainer):
+    """Federated plain VAE (federated_vae.py).
+
+    Differences from the classifier engine, all reproduced:
+      * LAYER-wise sweep via unfreeze_one_layer (federated_vae.py:129) while
+        ci still ranges over len(train_order_block_ids()) — for
+        AutoEncoderCNN both counts are 12, so every layer is visited;
+      * loss = sum-MSE + KLD, labels ignored (federated_vae.py:96-108);
+      * reparametrisation needs a PRNG key per batch;
+      * no L1/L2 regularisation anywhere (no linear_layer_ids test);
+      * the reference never evaluates on the test set (loss prints only,
+        federated_vae.py:173) — evaluate() here reports per-client test
+        ELBO instead (an improvement, flagged in eval_finalize).
+    """
+
+    sweep = "layers"
+    needs_rng = True
+
+    def sample_init_args(self):
+        return (jnp.zeros((1, 32, 32, 3), jnp.float32), jax.random.PRNGKey(0))
+
+    def reg_for_block(self, ci):
+        return (0.0, 0.0)
+
+    def model_loss(self, p, bs, xb, yb, rng):
+        recon, mu, logvar = self.model.apply({"params": p}, xb, rng)
+        return vae_loss(recon, xb, mu, logvar), bs
+
+    def eval_batch_metric(self, p, bs, xb, yb):
+        # fixed key: deterministic eval ELBO
+        recon, mu, logvar = self.model.apply(
+            {"params": p}, xb, jax.random.PRNGKey(0))
+        return vae_loss(recon, xb, mu, logvar)
+
+    def eval_finalize(self, totals: np.ndarray, n_samples: int) -> np.ndarray:
+        return totals / n_samples   # mean test ELBO per sample
+
+
+class VAECLTrainer(BlockwiseFederatedTrainer):
+    """Federated clustering VAE (federated_vae_cl.py).
+
+    * 3-block sweep (encoder / decoder / latent, simple_models.py:430-432);
+    * per-block optimizer: latent block (ci==2) -> Adam lr=1e-4; encoder /
+      decoder blocks -> LBFGSNew(history_size=10, max_iter=4, batch_mode)
+      (federated_vae_cl.py:200-205);
+    * reparametrisation ALWAYS active — the reference's disable_repr() is a
+      no-op (sets repr_flag=True, simple_models.py:344-345);
+    * L2 regularisation lambda2=1e-3 on the flat trainable vector for EVERY
+      block (federated_vae_cl.py:228-230), no L1;
+    * reference default K=1 (federated_vae_cl.py:12).
+    """
+
+    needs_rng = True
+
+    def sample_init_args(self):
+        return (jnp.zeros((1, 32, 32, 3), jnp.float32), jax.random.PRNGKey(0))
+
+    def optimizer_for_block(self, ci):
+        if ci == 2:                      # latent space block
+            return "adam"
+        return "lbfgs"
+
+    def lr_for_block(self, ci):
+        return 1e-4                      # federated_vae_cl.py:200
+
+    def reg_for_block(self, ci):
+        return (0.0, self.cfg.lambda2)   # unconditional L2 (:228-230)
+
+    def model_loss(self, p, bs, xb, yb, rng):
+        out = self.model.apply({"params": p}, xb, rng, reparam=True)
+        ekhat, mu_xi, sig2_xi, mu_b, sig2_b, mu_th, sig2_th = out
+        return vae_cl_loss(ekhat, mu_xi, sig2_xi, mu_b, sig2_b,
+                           mu_th, sig2_th, xb), bs
+
+    def eval_batch_metric(self, p, bs, xb, yb):
+        out = self.model.apply({"params": p}, xb, jax.random.PRNGKey(0),
+                               reparam=True)
+        ekhat, mu_xi, sig2_xi, mu_b, sig2_b, mu_th, sig2_th = out
+        return vae_cl_loss(ekhat, mu_xi, sig2_xi, mu_b, sig2_b,
+                           mu_th, sig2_th, xb)
+
+    def eval_finalize(self, totals: np.ndarray, n_samples: int) -> np.ndarray:
+        return totals / n_samples        # mean test ELBO per sample

@@ -1,0 +1,91 @@
+"""CPC workload tests: InfoNCE parity, LOFAR patching, trainer smoke."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from federated_pytorch_test_tpu.data.lofar import (
+    CPCDataSource,
+    extract_patches,
+    get_data_minibatch,
+)
+from federated_pytorch_test_tpu.train.cpc_losses import info_nce
+
+
+class TestInfoNCE:
+    def naive(self, z, zhat):
+        """Literal port of the reference's nested loops
+        (federated_cpc.py:149-180); z, zhat [B, C, px, py] NCHW."""
+        B, C, px, py = z.shape
+        P = px * py
+        Z = z.reshape(-1, P)
+        Zhat = zhat.reshape(-1, P)
+        zz = np.zeros((P, P))
+        for ci in range(P):
+            zn = np.linalg.norm(Z[:, ci])
+            for cj in range(P):
+                zz[ci, cj] = Z[:, ci] @ Zhat[:, cj] / (
+                    zn * np.linalg.norm(Zhat[:, cj]))
+        loss = 0.0
+        for ci in range(P):
+            num = np.exp(zz[ci, ci])
+            den = num + sum(np.exp(zz[ci, cj]) for cj in range(P) if cj != ci)
+            loss -= np.log(num / den + 1e-6)
+        return loss
+
+    def test_matches_reference_loops(self):
+        rng = np.random.default_rng(0)
+        z = rng.normal(size=(3, 4, 2, 3)).astype(np.float32)     # B,C,px,py
+        zh = rng.normal(size=(3, 4, 2, 3)).astype(np.float32)
+        # ours takes NHWC [B, px, py, C]
+        got = float(info_nce(jnp.asarray(z.transpose(0, 2, 3, 1)),
+                             jnp.asarray(zh.transpose(0, 2, 3, 1))))
+        np.testing.assert_allclose(got, self.naive(z, zh), rtol=1e-4)
+
+
+class TestLofarPipeline:
+    def test_extract_patches_shapes_and_content(self):
+        x = np.arange(2 * 3 * 64 * 64, dtype=np.float32).reshape(2, 3, 64, 64)
+        px, py, y = extract_patches(x, 32, 16)
+        assert (px, py) == (3, 3)
+        assert y.shape == (2 * 9, 3, 32, 32)
+        # row r = b*9 + ci*3 + cj (baseline-major; see deviation note)
+        np.testing.assert_array_equal(y[0], x[0, :, 0:32, 0:32])
+        np.testing.assert_array_equal(y[1], x[0, :, 0:32, 16:48])
+        np.testing.assert_array_equal(y[3], x[0, :, 16:48, 0:32])
+        np.testing.assert_array_equal(y[9], x[1, :, 0:32, 0:32])
+
+    def test_synthetic_minibatch(self):
+        rng = np.random.default_rng(0)
+        px, py, y = get_data_minibatch("no_such_file.h5", "0", batch_size=2,
+                                       rng=rng)
+        assert y.shape == (2 * px * py, 32, 32, 8)
+        assert y.dtype == np.float32
+        assert np.all(np.abs(y) <= 1e6)
+
+    def test_synthetic_cube_deterministic_per_file_sap(self):
+        r1 = np.random.default_rng(5)
+        r2 = np.random.default_rng(5)
+        _, _, a = get_data_minibatch("f.h5", "1", 2, rng=r1)
+        _, _, b = get_data_minibatch("f.h5", "1", 2, rng=r2)
+        np.testing.assert_array_equal(a, b)
+        _, _, c = get_data_minibatch("f.h5", "2", 2,
+                                     rng=np.random.default_rng(5))
+        assert not np.array_equal(a, c)
+
+    def test_round_batches_shape(self):
+        src = CPCDataSource(["a.h5", "b.h5"], ["0", "0"], batch_size=2)
+        px, py, batch = src.round_batches(niter=2)
+        assert batch.shape == (2, 2, 2 * px * py, 32, 32, 8)
+
+
+class TestCPCTrainer:
+    def test_rotation_trains_all_submodels(self):
+        from federated_pytorch_test_tpu.train.cpc_engine import CPCTrainer
+        src = CPCDataSource(["a.h5", "b.h5"], ["0", "1"], batch_size=2)
+        t = CPCTrainer(src, latent_dim=16, reduced_dim=4, Niter=2)
+        state, hist = t.run(Nloop=1, Nadmm=1, log=lambda m: None)
+        models = {h["model"] for h in hist}
+        assert models == {"encoder", "contextgen", "predictor"}
+        assert all(np.isfinite(h["dual_residual"]) for h in hist)
+        assert all(np.isfinite(h["loss"]) for h in hist)
