@@ -57,11 +57,6 @@ def _synthetic_cube(filename: str, sap: str, nbase: int = 64, ntime: int = 64,
     return vis.astype(np.float32), scale
 
 
-def _read_h5(filename: str, sap: str):
-    f = h5py.File(filename, "r")
-    g = f["measurement"]["saps"][sap]["visibilities"]
-    h = f["measurement"]["saps"][sap]["visibility_scale_factors"]
-    return f, g, h
 
 
 def extract_patches(x: np.ndarray, patch_size: int, stride: int) -> Tuple[int, int, np.ndarray]:
@@ -98,29 +93,27 @@ def get_data_minibatch(filename: str, SAP: str = "0", batch_size: int = 2,
     """
     rng = rng or np.random.default_rng()
     use_disk = HAVE_H5PY and os.path.isfile(filename)
+
+    def fill(x, g, h):
+        baselines = rng.integers(0, g.shape[0], batch_size)
+        for ck, mybase in enumerate(baselines):
+            for ci in range(4):
+                sf = np.asarray(h[mybase, :, ci])[None, :]   # [1, nfreq]
+                x[ck, 2 * ci] = np.asarray(g[mybase, :, :, ci, 0]) * sf
+                x[ck, 2 * ci + 1] = np.asarray(g[mybase, :, :, ci, 1]) * sf
+
     if use_disk:
-        f, g, h = _read_h5(filename, SAP)
-        nbase, ntime, nfreq, npol, _ = g.shape
+        with h5py.File(filename, "r") as f:
+            g = f["measurement"]["saps"][SAP]["visibilities"]
+            h = f["measurement"]["saps"][SAP]["visibility_scale_factors"]
+            nbase, ntime, nfreq, npol, _ = g.shape
+            x = np.zeros((batch_size, 8, ntime, nfreq), np.float32)
+            fill(x, g, h)
     else:
         vis, scale = _synthetic_cube(filename, SAP)
         nbase, ntime, nfreq, npol, _ = vis.shape
-
-    x = np.zeros((batch_size, 8, ntime, nfreq), np.float32)
-    baselines = rng.integers(0, nbase, batch_size)
-    for ck, mybase in enumerate(baselines):
-        for ci in range(4):
-            if use_disk:
-                sf = np.asarray(h[mybase, :, ci])[None, :]   # [1, nfreq]
-                re = np.asarray(g[mybase, :, :, ci, 0])
-                im = np.asarray(g[mybase, :, :, ci, 1])
-            else:
-                sf = scale[mybase, :, ci][None, :]
-                re = vis[mybase, :, :, ci, 0]
-                im = vis[mybase, :, :, ci, 1]
-            x[ck, 2 * ci] = re * sf
-            x[ck, 2 * ci + 1] = im * sf
-    if use_disk:
-        f.close()
+        x = np.zeros((batch_size, 8, ntime, nfreq), np.float32)
+        fill(x, vis, scale)
 
     px, py, y = extract_patches(x, patch_size, patch_size // 2)
     np.clip(y, -1e6, 1e6, out=y)

@@ -130,11 +130,10 @@ class CPCTrainer:
         K = self.K
         fwd = self._forward
 
-        def per_client(enc_p, ctx_p, pred_p, ys):
+        def per_client(enc_p, ctx_p, pred_p, os, ys):
             sub = {"encoder": enc_p, "contextgen": ctx_p,
                    "predictor": pred_p}[mdl]
             xflat0 = codec.get_trainable_values(sub, order, mask)
-            os0 = lbfgs.init(xflat0)
 
             def step(carry, y):
                 xflat, os = carry
@@ -150,30 +149,43 @@ class CPCTrainer:
                 xflat, os, loss = lbfgs.step(flat_loss, xflat, os)
                 return (xflat, os), loss
 
-            (xflat, _), losses = lax.scan(step, (xflat0, os0), ys)
-            return xflat, jnp.sum(losses)
+            (xflat, os), losses = lax.scan(step, (xflat0, os), ys)
+            return xflat, os, jnp.sum(losses)
 
-        def round_shard(state: CPCState, z, data):
+        def round_shard(state: CPCState, z, opt_state, data):
             # data: [K_local, Niter, nbatch, ps, ps, 8]
-            xflat, losses = jax.vmap(per_client)(
-                state.encoder, state.contextgen, state.predictor, data)
+            # opt_state persists across Nadmm rounds — the reference creates
+            # the optimizer once per (sub-model, block) BEFORE the nadmm loop
+            # (federated_cpc.py:241-252), so curvature history carries over
+            xflat, opt_state, losses = jax.vmap(per_client)(
+                state.encoder, state.contextgen, state.predictor, opt_state,
+                data)
             znew = federated_mean(xflat, K)               # fedavg (:289-296)
             dual = jnp.linalg.norm(z - znew) / N          # (:295)
             sub = getattr(state, mdl)
             sub = jax.vmap(
                 lambda p: codec.put_trainable_values(p, order, mask, znew)
             )(sub)                                        # write-back (:299-304)
-            return state._replace(**{mdl: sub}), znew, dual, losses
+            return state._replace(**{mdl: sub}), znew, opt_state, dual, losses
+
+        def init_opt(state: CPCState):
+            sub = getattr(state, mdl)
+            return jax.vmap(
+                lambda p: lbfgs.init(
+                    codec.get_trainable_values(p, order, mask)))(sub)
 
         spec_c = P(CLIENT_AXIS)
         spec_r = P()
         state_spec = CPCState(spec_c, spec_c, spec_c)
         fn = jax.jit(
             shard_map(round_shard, mesh=self.mesh,
-                      in_specs=(state_spec, spec_r, spec_c),
-                      out_specs=(state_spec, spec_r, spec_r, spec_c),
+                      in_specs=(state_spec, spec_r, spec_c, spec_c),
+                      out_specs=(state_spec, spec_r, spec_c, spec_r, spec_c),
                       check_vma=False))
-        self._fn_cache[key] = (fn, N)
+        init_fn = jax.jit(
+            shard_map(init_opt, mesh=self.mesh, in_specs=(state_spec,),
+                      out_specs=spec_c, check_vma=False))
+        self._fn_cache[key] = (fn, init_fn, N)
         return self._fn_cache[key]
 
     # ------------------------------------------------------------------
@@ -188,14 +200,15 @@ class CPCTrainer:
             for mdl in SUBMODELS:
                 blocks = self.models[mdl].train_order_block_ids()
                 for ci in range(len(blocks)):
-                    z = None
+                    z = opt_state = None
                     for nadmm in range(Nadmm):
                         px, py, batch = self.data.round_batches(self.Niter)
-                        fn, N = self._build_round(mdl, ci, px, py)
+                        fn, init_fn, N = self._build_round(mdl, ci, px, py)
                         if z is None:
                             z = jnp.zeros((N,), jnp.float32)
-                        state, z, dual, losses = fn(
-                            state, z, jax.device_put(batch, csh))
+                            opt_state = init_fn(state)
+                        state, z, opt_state, dual, losses = fn(
+                            state, z, opt_state, jax.device_put(batch, csh))
                         rec = dict(nloop=nloop, model=mdl, block=ci,
                                    nadmm=nadmm, N=N,
                                    dual_residual=float(dual),

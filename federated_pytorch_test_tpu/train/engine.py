@@ -81,8 +81,6 @@ class BlockwiseFederatedTrainer:
     #: "layers" sweeps (weight, bias) pairs — the VAE driver's
     #: unfreeze_one_layer path (federated_vae.py:129)
     sweep: str = "blocks"
-    #: whether model_loss consumes a PRNG key (VAE reparametrisation)
-    needs_rng: bool = False
 
     def sample_init_args(self):
         """Args after rng for ``model.init`` (overridden by rng-taking models)."""
@@ -106,13 +104,16 @@ class BlockwiseFederatedTrainer:
         self.order = model.param_order()
         self.block_ids = model.train_order_block_ids()
         self.linear_ids = model.linear_layer_ids()
+        # in BOTH sweep modes ci ranges over len(train_order_block_ids()):
+        # the reference VAE driver iterates that count but freezes LAYER ci
+        # (federated_vae.py:126-129) — for its models layer and block counts
+        # coincide; assert that so a mismatched future model fails loudly
+        self.L = len(self.block_ids)
         if self.sweep == "layers":
-            # reference quirk preserved: the VAE driver iterates ci over
-            # range(len(train_order_block_ids())) but freezes LAYER ci
-            # (federated_vae.py:126-129), so L is still the block count
-            self.L = len(self.block_ids)
-        else:
-            self.L = len(self.block_ids)
+            n_layers = (len(self.order) + 1) // 2
+            assert self.L == n_layers, (
+                f"layer sweep needs len(train_order_block_ids())=={n_layers} "
+                f"(layers), got {self.L}")
 
         K = cfg.K
         if mesh is None:
@@ -192,7 +193,7 @@ class BlockwiseFederatedTrainer:
         """Per-batch core loss -> (scalar, new_batch_stats).
 
         Classifier default: CE on logits (federated_multi.py:178-189).
-        Subclasses override for VAE/VAE-CL losses (and set needs_rng).
+        Subclasses override for VAE/VAE-CL losses.
         """
         logits, new_bs = self._apply_train(p, bs, xb)
         return self.loss_fn(logits, yb), new_bs

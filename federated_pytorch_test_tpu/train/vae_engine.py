@@ -31,7 +31,6 @@ class VAETrainer(BlockwiseFederatedTrainer):
     """
 
     sweep = "layers"
-    needs_rng = True
 
     def sample_init_args(self):
         return (jnp.zeros((1, 32, 32, 3), jnp.float32), jax.random.PRNGKey(0))
@@ -67,7 +66,6 @@ class VAECLTrainer(BlockwiseFederatedTrainer):
     * reference default K=1 (federated_vae_cl.py:12).
     """
 
-    needs_rng = True
 
     def sample_init_args(self):
         return (jnp.zeros((1, 32, 32, 3), jnp.float32), jax.random.PRNGKey(0))
