@@ -1,0 +1,88 @@
+"""Headline benchmark: federated CIFAR10 training throughput on TPU.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+The reference publishes no quantitative numbers (BASELINE.md); the driver-set
+target is >=5,000 CIFAR10 images/sec/chip for the consensus ResNet18 config
+(BASELINE.json), so ``vs_baseline`` is value / 5000.
+
+Measures the real production path — the jitted shard_map training epoch of
+the ADMM-consensus ResNet18 driver (local Adam steps + masked block grads)
+with data staged once — on however many chips are visible (1 under axon).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+TARGET = 5000.0  # images/sec/chip (BASELINE.json north star)
+
+
+def main():
+    from federated_pytorch_test_tpu.data.cifar10 import FederatedCifar10
+    from federated_pytorch_test_tpu.models.resnet import ResNet18
+    from federated_pytorch_test_tpu.train import (
+        AdmmConsensus,
+        BlockwiseFederatedTrainer,
+        FederatedConfig,
+    )
+
+    n_chips = len(jax.devices())
+    K = 8 * n_chips                     # 8 clients per chip
+    batch = 128
+    steps = 8                           # minibatches per client per epoch
+
+    cfg = FederatedConfig(K=K, default_batch=batch, check_results=False,
+                          use_resnet=True, admm_rho0=0.1)
+    data = FederatedCifar10(K=K, batch=batch,
+                            limit_per_client=steps * batch, limit_test=batch)
+    trainer = BlockwiseFederatedTrainer(ResNet18(), cfg, data, AdmmConsensus())
+
+    ci = 0                              # first ResNet block (stem): N=1856
+    train_epoch, comm_fns, init_opt = trainer._build_fns(ci)
+    N = trainer.block_size(ci)
+    state = trainer.init_state()
+    state = state._replace(opt_state=init_opt(state.params))
+    import jax.numpy as jnp
+    from federated_pytorch_test_tpu.parallel.mesh import client_sharding
+    csh = client_sharding(trainer.mesh)
+    rsh = jax.sharding.NamedSharding(trainer.mesh, jax.sharding.PartitionSpec())
+    z = jax.device_put(jnp.zeros((N,), jnp.float32), rsh)
+    y = jax.device_put(jnp.zeros((K, N), jnp.float32), csh)
+    rho = jax.device_put(jnp.float32(cfg.admm_rho0), rsh)
+    xb, yb = trainer._stage_epoch()
+    keys = trainer._epoch_keys()
+
+    def epoch(state):
+        return train_epoch(state, y, trainer.client_mean, keys, xb, yb, z, rho)
+
+    # warm-up / compile.  NOTE: under the axon relay block_until_ready does
+    # not actually block, so benchmarks must force a host fetch of a value
+    # that depends on the full computation.
+    state, losses = epoch(state)
+    np.asarray(losses)
+
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        state, losses = epoch(state)
+    np.asarray(losses)          # sync: losses depend on every local step
+    dt = time.perf_counter() - t0
+
+    images = reps * K * steps * batch
+    per_chip = images / dt / n_chips
+    print(json.dumps({
+        "metric": "cifar10_resnet18_consensus_train_throughput",
+        "value": round(per_chip, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / TARGET, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,20 @@
+"""Driver-contract tests: entry() compile check + multi-chip dry run."""
+
+import sys
+
+import jax
+import pytest
+
+sys.path.insert(0, "/root/repo")
+
+
+class TestGraftEntry:
+    def test_entry_jits(self):
+        import __graft_entry__
+        fn, args = __graft_entry__.entry()
+        out = jax.jit(fn)(*args)
+        assert out.shape == (8, 10)
+
+    def test_dryrun_multichip_8(self):
+        import __graft_entry__
+        __graft_entry__.dryrun_multichip(8)
