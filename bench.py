@@ -32,23 +32,27 @@ def main():
         FederatedConfig,
     )
 
+    import jax.numpy as jnp
+
     n_chips = len(jax.devices())
-    K = 8 * n_chips                     # 8 clients per chip
+    K = 16 * n_chips                    # 16 clients per chip (throughput knee)
     batch = 128
     steps = 8                           # minibatches per client per epoch
 
     cfg = FederatedConfig(K=K, default_batch=batch, check_results=False,
-                          use_resnet=True, admm_rho0=0.1)
+                          use_resnet=True, admm_rho0=0.1, bf16=True)
     data = FederatedCifar10(K=K, batch=batch,
                             limit_per_client=steps * batch, limit_test=batch)
-    trainer = BlockwiseFederatedTrainer(ResNet18(), cfg, data, AdmmConsensus())
+    # bf16 conv/dense compute (params, BN and head stay f32) feeds the MXU
+    # at full rate: ~1.5x over f32 on v5e
+    trainer = BlockwiseFederatedTrainer(ResNet18(dtype=jnp.bfloat16), cfg,
+                                        data, AdmmConsensus())
 
     ci = 0                              # first ResNet block (stem): N=1856
     train_epoch, comm_fns, init_opt = trainer._build_fns(ci)
     N = trainer.block_size(ci)
     state = trainer.init_state()
     state = state._replace(opt_state=init_opt(state.params))
-    import jax.numpy as jnp
     from federated_pytorch_test_tpu.parallel.mesh import client_sharding
     csh = client_sharding(trainer.mesh)
     rsh = jax.sharding.NamedSharding(trainer.mesh, jax.sharding.PartitionSpec())

@@ -62,7 +62,9 @@ def config_from_args(args: argparse.Namespace) -> FederatedConfig:
 def make_trainer(cfg: FederatedConfig, algorithm: Algorithm,
                  n_train: Optional[int] = None,
                  n_test: Optional[int] = None) -> BlockwiseFederatedTrainer:
-    model = ResNet18() if cfg.use_resnet else Net()
+    import jax.numpy as jnp
+    dtype = jnp.bfloat16 if cfg.bf16 else None
+    model = ResNet18(dtype=dtype) if cfg.use_resnet else Net(dtype=dtype)
     data = FederatedCifar10(
         K=cfg.K, batch=cfg.default_batch, biased_input=cfg.biased_input,
         drop_last_sample=cfg.drop_last_sample, data_dir=cfg.data_dir,

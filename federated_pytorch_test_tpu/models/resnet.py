@@ -11,7 +11,7 @@ in ``parameters()``; see SURVEY.md section 7 "BatchNorm under federation").
 
 from __future__ import annotations
 
-from typing import List, Sequence
+from typing import Any, List, Optional, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -20,8 +20,11 @@ from federated_pytorch_test_tpu.models.base import BlockModule, elu, pairs
 
 
 def _bn(name: str):
-    # torch BatchNorm2d defaults: eps=1e-5, momentum=0.1 (flax momentum=0.9)
-    return nn.BatchNorm(momentum=0.9, epsilon=1e-5, name=name)
+    # torch BatchNorm2d defaults: eps=1e-5, momentum=0.1 (flax momentum=0.9).
+    # BN always computes in float32 (params are float32 too) — only the
+    # convs/dense run in the compute dtype.
+    return nn.BatchNorm(momentum=0.9, epsilon=1e-5, dtype=jnp.float32,
+                        name=name)
 
 
 class BasicBlock(nn.Module):
@@ -33,20 +36,22 @@ class BasicBlock(nn.Module):
     planes: int
     stride: int = 1
     expansion: int = 1
+    dtype: Optional[Any] = None   # compute dtype for convs (bf16 on TPU)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
         in_planes = x.shape[-1]
         out = nn.Conv(self.planes, (3, 3), strides=(self.stride, self.stride),
-                      padding="SAME", use_bias=False, name="conv1")(x)
+                      padding="SAME", use_bias=False, dtype=self.dtype,
+                      name="conv1")(x)
         out = elu(_bn("bn1")(out, use_running_average=not train))
         out = nn.Conv(self.planes, (3, 3), padding="SAME", use_bias=False,
-                      name="conv2")(out)
+                      dtype=self.dtype, name="conv2")(out)
         out = _bn("bn2")(out, use_running_average=not train)
         if self.stride != 1 or in_planes != self.expansion * self.planes:
             sc = nn.Conv(self.expansion * self.planes, (1, 1),
                          strides=(self.stride, self.stride), use_bias=False,
-                         name="shortcut_conv")(x)
+                         dtype=self.dtype, name="shortcut_conv")(x)
             sc = _bn("shortcut_bn")(sc, use_running_average=not train)
         else:
             sc = x
@@ -63,22 +68,25 @@ class Bottleneck(nn.Module):
     planes: int
     stride: int = 1
     expansion: int = 4
+    dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
         in_planes = x.shape[-1]
-        out = nn.Conv(self.planes, (1, 1), use_bias=False, name="conv1")(x)
+        out = nn.Conv(self.planes, (1, 1), use_bias=False, dtype=self.dtype,
+                      name="conv1")(x)
         out = elu(_bn("bn1")(out, use_running_average=not train))
         out = nn.Conv(self.planes, (3, 3), strides=(self.stride, self.stride),
-                      padding="SAME", use_bias=False, name="conv2")(out)
+                      padding="SAME", use_bias=False, dtype=self.dtype,
+                      name="conv2")(out)
         out = elu(_bn("bn2")(out, use_running_average=not train))
         out = nn.Conv(self.expansion * self.planes, (1, 1), use_bias=False,
-                      name="conv3")(out)
+                      dtype=self.dtype, name="conv3")(out)
         out = _bn("bn3")(out, use_running_average=not train)
         if self.stride != 1 or in_planes != self.expansion * self.planes:
             sc = nn.Conv(self.expansion * self.planes, (1, 1),
                          strides=(self.stride, self.stride), use_bias=False,
-                         name="shortcut_conv")(x)
+                         dtype=self.dtype, name="shortcut_conv")(x)
             sc = _bn("shortcut_bn")(sc, use_running_average=not train)
         else:
             sc = x
@@ -96,10 +104,14 @@ class ResNet(BlockModule):
     qualifier: int = 18  # 9 or 18 — selects the hand-made block partition
     num_classes: int = 10
     bottleneck: bool = False
+    #: compute dtype for convs/dense (params stay float32; BN and the loss
+    #: run in float32).  bfloat16 feeds the MXU at full rate on TPU.
+    dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
-        out = nn.Conv(64, (3, 3), padding="SAME", use_bias=False, name="conv1")(x)
+        out = nn.Conv(64, (3, 3), padding="SAME", use_bias=False,
+                      dtype=self.dtype, name="conv1")(x)
         out = elu(_bn("bn1")(out, use_running_average=not train))
         block_cls = Bottleneck if self.bottleneck else BasicBlock
         for stage, (planes, stride, n) in enumerate(
@@ -107,11 +119,13 @@ class ResNet(BlockModule):
         ):
             strides = [stride] + [1] * (n - 1)
             for i, s in enumerate(strides):
-                out = block_cls(planes=planes, stride=s,
+                out = block_cls(planes=planes, stride=s, dtype=self.dtype,
                                 name=f"layer{stage}_{i}")(out, train=train)
         out = nn.avg_pool(out, window_shape=(4, 4), strides=(4, 4))
         out = out.reshape((out.shape[0], -1))
-        return nn.Dense(self.num_classes, name="linear")(out)
+        # head in float32 for numerically stable logits/CE
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="linear")(out.astype(jnp.float32))
 
     # -- federation metadata ------------------------------------------------
     def param_order(self) -> List[str]:
@@ -153,11 +167,11 @@ class ResNet(BlockModule):
         return []
 
 
-def ResNet18() -> ResNet:
+def ResNet18(dtype=None) -> ResNet:
     """Reference simple_models.py:233-234."""
-    return ResNet(num_blocks=(2, 2, 2, 2), qualifier=18)
+    return ResNet(num_blocks=(2, 2, 2, 2), qualifier=18, dtype=dtype)
 
 
-def ResNet9() -> ResNet:
+def ResNet9(dtype=None) -> ResNet:
     """Reference simple_models.py:236-237."""
-    return ResNet(num_blocks=(1, 1, 1, 1), qualifier=9)
+    return ResNet(num_blocks=(1, 1, 1, 1), qualifier=9, dtype=dtype)

@@ -11,7 +11,7 @@ are HWIO and activations NHWC (vs torch OIHW/NCHW) for MXU-friendly layouts.
 
 from __future__ import annotations
 
-from typing import List
+from typing import Any, List
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -29,15 +29,20 @@ class Net(BlockModule):
     """conv(3→6,5) → pool → conv(6→16,5) → pool → fc 400→120→84→10."""
 
     num_classes: int = 10
+    dtype: Any = None  # compute dtype (bf16 on TPU); params & head stay f32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
-        x = max_pool_2x2(elu(nn.Conv(6, (5, 5), padding="VALID", name="conv1")(x)))
-        x = max_pool_2x2(elu(nn.Conv(16, (5, 5), padding="VALID", name="conv2")(x)))
+        d = self.dtype
+        x = max_pool_2x2(elu(nn.Conv(6, (5, 5), padding="VALID", dtype=d,
+                                     name="conv1")(x)))
+        x = max_pool_2x2(elu(nn.Conv(16, (5, 5), padding="VALID", dtype=d,
+                                     name="conv2")(x)))
         x = flatten(x)  # 5*5*16 = 400
-        x = elu(nn.Dense(120, name="fc1")(x))
-        x = elu(nn.Dense(84, name="fc2")(x))
-        return nn.Dense(self.num_classes, name="fc3")(x)
+        x = elu(nn.Dense(120, dtype=d, name="fc1")(x))
+        x = elu(nn.Dense(84, dtype=d, name="fc2")(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="fc3")(x.astype(jnp.float32))
 
     def param_order(self) -> List[str]:
         return pairs("conv1", "conv2", "fc1", "fc2", "fc3")

@@ -55,6 +55,7 @@ class FederatedConfig:
     # line_search_fn=True, batch_mode=True), federated_multi.py:158)
     optimizer: str = "adam"        # "adam" | "lbfgs"
     lr: float = 1e-3
+    bf16: bool = False             # bfloat16 compute for convs/dense (MXU rate)
     lbfgs_history_size: int = 10
     lbfgs_max_iter: int = 4
 
