@@ -10,10 +10,16 @@ Re-design of the reference's per-client loader block (duplicated ~35 lines in
     no_consensus_multi.py:43-46) is reproduced behind ``drop_last_sample``
     (default True for parity);
   * normalisation to [-1, 1] (``Normalize((0.5,0.5,0.5),(0.5,0.5,0.5))``),
-    with optional per-client biased means ``(0.5 + k/100, 0.5 - k/100, 0.5)``
-    simulating non-IID inputs (``biased_input``, federated_multi.py:60-71);
+    with optional per-client biased means AND stds ``(0.5 + k/100,
+    0.5 - k/100, 0.5)`` simulating non-IID inputs (the reference biases
+    both arguments of Normalize, federated_multi.py:66);
   * every client evaluates on the full 10000-image test set
-    (federated_multi.py:84-85).
+    (federated_multi.py:84-85);
+  * partial final minibatches are kept (torch DataLoader drop_last=False,
+    federated_multi.py:74-83): the last batch is padded to the static batch
+    size by wrapping around the shuffled permutation, and a per-sample
+    weight array marks the pad rows with 0 so losses/metrics exclude them
+    (``include_remainder``, default True).
 
 TPU-first: instead of K torch ``DataLoader`` objects iterated sequentially,
 the pipeline materialises dense ``[K, steps, batch, 32, 32, 3]`` NHWC arrays
@@ -63,24 +69,37 @@ def _load_pickle_batches(dirname: str) -> Tuple[np.ndarray, np.ndarray, np.ndarr
     return np.concatenate(xs), np.concatenate(ys), xte, yte
 
 
-def _synthetic_cifar10(seed: int = 0) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+def _synthetic_cifar10(seed: int = 0, noise: float = 48.0,
+                       prototypes: int = 1
+                       ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Deterministic CIFAR-10 stand-in with learnable class structure.
 
-    Each class c gets a fixed low-frequency template image; samples are the
-    template plus moderate pixel noise, clipped to uint8.  A linear probe
-    separates the classes, and accuracy curves behave qualitatively like the
-    real dataset (rises well above 10% chance), which is what the reference's
-    only benchmark artifact measures (README.md:28-30).
+    Each class c gets ``prototypes`` fixed low-frequency template images;
+    a sample is a randomly chosen class prototype plus pixel noise (std
+    ``noise``), clipped to uint8.  With the default single prototype a
+    linear probe separates the classes and accuracy curves behave
+    qualitatively like the real dataset (rise well above 10% chance),
+    which is what the reference's only benchmark artifact measures
+    (README.md:28-30).
+
+    With many prototypes the prototypes are mutually unpredictable, so
+    test accuracy scales with how many of them the training data covered —
+    i.e. with sample count.  The accuracy-parity comparison uses this to
+    make the published K=1 >= federated >= standalone-1/K ordering
+    non-degenerate on synthetic data (a 1/K shard covers ~1/K of the
+    prototype clusters).
     """
     rng = np.random.default_rng(seed)
-    # low-frequency templates: upsampled 4x4 random patterns per class/channel
-    coarse = rng.uniform(40.0, 215.0, size=(NUM_CLASSES, 4, 4, 3))
-    templates = np.repeat(np.repeat(coarse, 8, axis=1), 8, axis=2)  # [10,32,32,3]
+    # low-frequency templates: upsampled 4x4 random patterns per
+    # class/prototype/channel
+    coarse = rng.uniform(40.0, 215.0, size=(NUM_CLASSES, prototypes, 4, 4, 3))
+    templates = np.repeat(np.repeat(coarse, 8, axis=2), 8, axis=3)
 
     def make(n, rng):
         y = rng.integers(0, NUM_CLASSES, size=n).astype(np.int32)
-        noise = rng.normal(0.0, 48.0, size=(n,) + IMAGE_SHAPE)
-        x = np.clip(templates[y] + noise, 0, 255).astype(np.uint8)
+        proto = rng.integers(0, prototypes, size=n)
+        nz = rng.normal(0.0, noise, size=(n,) + IMAGE_SHAPE)
+        x = np.clip(templates[y, proto] + nz, 0, 255).astype(np.uint8)
         return x, y
 
     xtr, ytr = make(TRAIN_SIZE, rng)
@@ -88,7 +107,9 @@ def _synthetic_cifar10(seed: int = 0) -> Tuple[np.ndarray, np.ndarray, np.ndarra
     return xtr, ytr, xte, yte
 
 
-def load_cifar10_arrays(data_dir: Optional[str] = None, synthetic_seed: int = 0):
+def load_cifar10_arrays(data_dir: Optional[str] = None, synthetic_seed: int = 0,
+                        synthetic_noise: float = 48.0,
+                        synthetic_prototypes: int = 1):
     """(train_x, train_y, test_x, test_y) as (uint8 NHWC, int32) arrays.
 
     Tries ``data_dir``, then $CIFAR10_DIR, then the standard search paths;
@@ -104,14 +125,22 @@ def load_cifar10_arrays(data_dir: Optional[str] = None, synthetic_seed: int = 0)
     for d in candidates:
         if os.path.isfile(os.path.join(d, "data_batch_1")):
             return (*_load_pickle_batches(d), "disk")
-    return (*_synthetic_cifar10(synthetic_seed), "synthetic")
+    return (*_synthetic_cifar10(synthetic_seed, synthetic_noise,
+                                synthetic_prototypes), "synthetic")
 
 
-def normalize(x_uint8: np.ndarray, mean: Tuple[float, float, float]) -> np.ndarray:
-    """ToTensor + Normalize(mean, (0.5, 0.5, 0.5)) — federated_multi.py:62-71."""
+def normalize(x_uint8: np.ndarray, mean: Tuple[float, float, float],
+              std: Optional[Tuple[float, float, float]] = None) -> np.ndarray:
+    """ToTensor + Normalize(mean, std) — federated_multi.py:62-71.
+
+    The reference passes the SAME triple for mean and std (both the plain
+    ``(0.5,0.5,0.5)`` and the biased ``(0.5+k/100, 0.5-k/100, 0.5)`` cases,
+    federated_multi.py:66), so ``std`` defaults to ``mean``.
+    """
     x = x_uint8.astype(np.float32) / 255.0
     m = np.asarray(mean, dtype=np.float32)
-    return (x - m) / 0.5
+    s = m if std is None else np.asarray(std, dtype=np.float32)
+    return (x - m) / s
 
 
 def client_means(K: int, biased_input: bool) -> np.ndarray:
@@ -120,6 +149,16 @@ def client_means(K: int, biased_input: bool) -> np.ndarray:
         return np.tile(np.float32([0.5, 0.5, 0.5]), (K, 1))
     ks = np.arange(K, dtype=np.float32)
     return np.stack([0.5 + ks / 100.0, 0.5 - ks / 100.0, np.full(K, 0.5, np.float32)], axis=1)
+
+
+def client_norm_stats(K: int, biased_input: bool) -> np.ndarray:
+    """Per-client (mean, std) pairs [K, 2, 3] — federated_multi.py:66.
+
+    The reference's Normalize biases mean and std with the SAME per-client
+    triple; the plain case uses 0.5 for both.
+    """
+    m = client_means(K, biased_input)
+    return np.stack([m, m], axis=1)
 
 
 def shard_indices(K: int, n: int = TRAIN_SIZE, drop_last_sample: bool = True) -> List[np.ndarray]:
@@ -143,11 +182,18 @@ def shard_indices(K: int, n: int = TRAIN_SIZE, drop_last_sample: bool = True) ->
 class FederatedCifar10:
     """K-client CIFAR10 with dense per-epoch batch tensors.
 
-    Usage::
+    Usage (the production uint8 + sample-weight API)::
 
         data = FederatedCifar10(K=8, batch=128, biased_input=False)
-        xb, yb = data.epoch_batches(rng_seed)   # [K, steps, B, 32, 32, 3], [K, steps, B]
-        xt, yt = data.test_batches()            # [K, tsteps, B, 32, 32, 3], ...
+        xb, yb, wb = data.epoch_batches_raw(seed)  # [K, steps, B, 32,32,3] u8,
+                                                   # [K, steps, B] i32/f32
+        xt, yt, wt = data.test_batches_raw()       # [tsteps, B, ...]
+
+    ``steps`` counts the wrap-padded remainder batch when
+    ``include_remainder`` (pad rows weighted 0); the host-float convenience
+    methods ``epoch_batches``/``test_batches`` return FULL batches only
+    (``samples_per_client // batch`` steps), which is fewer than ``.steps``
+    whenever a remainder exists.
 
     The leading axis is the client mesh axis.  Every client gets the same
     number of steps (shards are equal-sized by construction); the per-epoch
@@ -159,24 +205,31 @@ class FederatedCifar10:
     batch: int = 128
     biased_input: bool = False
     drop_last_sample: bool = True
+    include_remainder: bool = True  # torch drop_last=False parity (:74-83)
     data_dir: Optional[str] = None
     synthetic_seed: int = 0
+    synthetic_noise: float = 48.0           # pixel-noise std of the fallback
+    synthetic_prototypes: int = 1           # templates per class (fallback)
     limit_per_client: Optional[int] = None  # cap shard size (tests/benchmarks)
     limit_test: Optional[int] = None        # cap test-set size (tests)
     # filled in __post_init__
     source: str = field(init=False, default="")
 
     def __post_init__(self):
-        xtr, ytr, xte, yte, src = load_cifar10_arrays(self.data_dir, self.synthetic_seed)
+        xtr, ytr, xte, yte, src = load_cifar10_arrays(
+            self.data_dir, self.synthetic_seed, self.synthetic_noise,
+            self.synthetic_prototypes)
         self.source = src
-        self._means = client_means(self.K, self.biased_input)
+        self._norm = client_norm_stats(self.K, self.biased_input)
         idx = shard_indices(self.K, len(xtr), self.drop_last_sample)
         n_min = min(len(i) for i in idx)
         if self.limit_per_client:
             n_min = min(n_min, self.limit_per_client)
         if self.limit_test:
             xte, yte = xte[: self.limit_test], yte[: self.limit_test]
-        self.steps = n_min // self.batch
+        full = n_min // self.batch
+        self.remainder = n_min - full * self.batch if self.include_remainder else 0
+        self.steps = full + (1 if self.remainder else 0)
         # store raw uint8 shards; normalisation is applied per epoch (cheap,
         # and biased means are per-client so can't be pre-folded globally)
         self._train_x = np.stack([xtr[i[:n_min]] for i in idx])  # [K, n, 32,32,3] u8
@@ -191,45 +244,81 @@ class FederatedCifar10:
     @property
     def means(self) -> np.ndarray:
         """Per-client normalisation means [K, 3] (federated_multi.py:60-71)."""
-        return self._means
+        return self._norm[:, 0]
 
-    def epoch_batches_raw(self, seed: int) -> Tuple[np.ndarray, np.ndarray]:
-        """One shuffled epoch as raw uint8: [K, steps, B, 32,32,3], [K, steps, B].
+    @property
+    def norm_stats(self) -> np.ndarray:
+        """Per-client (mean, std) [K, 2, 3] (federated_multi.py:66)."""
+        return self._norm
+
+    def epoch_batches_raw(self, seed: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """One shuffled epoch as raw uint8: ([K, steps, B, 32,32,3],
+        [K, steps, B] labels, [K, steps, B] f32 sample weights).
 
         Normalisation happens on-device inside the jitted step (the engine
         folds in the per-client biased means), so the host only permutes
         uint8 — 4x less host->device traffic than staging float32.
+
+        The final partial minibatch (DataLoader drop_last=False,
+        federated_multi.py:74-83) is padded to the static batch size by
+        wrapping around the permutation; pad rows carry weight 0.
         """
         rng = np.random.default_rng(seed)
         n = self.steps * self.batch
+        w_flat = np.ones(n, np.float32)
+        if self.remainder:
+            w_flat[self.steps * self.batch - self.batch + self.remainder:] = 0.0
         xs, ys = [], []
         for ck in range(self.K):
-            perm = rng.permutation(self.samples_per_client)[:n]
+            perm = rng.permutation(self.samples_per_client)
+            if n > len(perm):                 # wrap-pad the remainder batch
+                perm = np.concatenate([perm, perm[: n - len(perm)]])
+            perm = perm[:n]
             xs.append(self._train_x[ck, perm].reshape(
                 self.steps, self.batch, *IMAGE_SHAPE))
             ys.append(self._train_y[ck, perm].reshape(self.steps, self.batch))
-        return np.stack(xs), np.stack(ys)
+        w = np.tile(w_flat.reshape(1, self.steps, self.batch), (self.K, 1, 1))
+        return np.stack(xs), np.stack(ys), w
 
-    def test_batches_raw(self, batch: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
+    def test_batches_raw(self, batch: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Full test set ONCE (not per client) as uint8 [tsteps, B, ...] plus
-        labels [tsteps, B]; clients differ only in their normalisation means,
-        which the engine applies on-device."""
+        labels [tsteps, B] and f32 weights [tsteps, B]; clients differ only
+        in their normalisation stats, which the engine applies on-device.
+
+        With ``include_remainder`` (default) the test set is wrap-padded so
+        ALL samples are evaluated (reference parity: the 10k set is not a
+        batch multiple of 128; pad rows carry weight 0)."""
         b = batch or self.batch
-        tsteps = len(self._test_x) // b
+        n_test = len(self._test_x)
+        if self.include_remainder:
+            tsteps = -(-n_test // b)
+            n = tsteps * b
+            pad = np.arange(n) % n_test       # wrap-pad
+            w = np.ones(n, np.float32)
+            w[n_test:] = 0.0
+            return (self._test_x[pad].reshape(tsteps, b, *IMAGE_SHAPE),
+                    self._test_y[pad].reshape(tsteps, b),
+                    w.reshape(tsteps, b))
+        tsteps = n_test // b
         n = tsteps * b
         return (self._test_x[:n].reshape(tsteps, b, *IMAGE_SHAPE),
-                self._test_y[:n].reshape(tsteps, b))
+                self._test_y[:n].reshape(tsteps, b),
+                np.ones((tsteps, b), np.float32))
 
     def epoch_batches(self, seed: int) -> Tuple[np.ndarray, np.ndarray]:
-        """One epoch of shuffled minibatches: [K, steps, B, 32,32,3] f32, [K, steps, B] i32."""
+        """One epoch of FULL shuffled minibatches as host float32 (convenience
+        for tests/notebooks): [K, full, B, 32,32,3] f32, [K, full, B] i32.
+        The production path is ``epoch_batches_raw`` (uint8 + weights)."""
         rng = np.random.default_rng(seed)
-        n = self.steps * self.batch
+        full = self.samples_per_client // self.batch
+        n = full * self.batch
         xs, ys = [], []
         for ck in range(self.K):
             perm = rng.permutation(self.samples_per_client)[:n]
-            x = normalize(self._train_x[ck, perm], tuple(self._means[ck]))
-            xs.append(x.reshape(self.steps, self.batch, *IMAGE_SHAPE))
-            ys.append(self._train_y[ck, perm].reshape(self.steps, self.batch))
+            x = normalize(self._train_x[ck, perm], tuple(self._norm[ck, 0]),
+                          tuple(self._norm[ck, 1]))
+            xs.append(x.reshape(full, self.batch, *IMAGE_SHAPE))
+            ys.append(self._train_y[ck, perm].reshape(full, self.batch))
         return np.stack(xs), np.stack(ys)
 
     def test_batches(self, batch: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
@@ -237,14 +326,17 @@ class FederatedCifar10:
 
         Reference parity: every client evaluates on the complete 10k test set
         under its own (possibly biased) normalisation (federated_multi.py:84-85,
-        :108-121).  Returns [K, tsteps, B, ...] arrays (remainder dropped).
+        :108-121).  Returns [K, tsteps, B, ...] arrays (remainder dropped —
+        host-float convenience; the engine's eval path covers the remainder
+        via ``test_batches_raw`` weights).
         """
         b = batch or self.batch
         tsteps = len(self._test_x) // b
         n = tsteps * b
         xs = []
         for ck in range(self.K):
-            x = normalize(self._test_x[:n], tuple(self._means[ck]))
+            x = normalize(self._test_x[:n], tuple(self._norm[ck, 0]),
+                          tuple(self._norm[ck, 1]))
             xs.append(x.reshape(tsteps, b, *IMAGE_SHAPE))
         y = np.tile(self._test_y[:n].reshape(1, tsteps, b), (self.K, 1, 1))
         return np.stack(xs), y

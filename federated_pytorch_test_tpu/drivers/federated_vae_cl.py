@@ -28,16 +28,21 @@ def main(argv=None):
                    help="latent dimension (federated_vae_cl.py:23)")
     args = p.parse_args(argv)
     cfg = common.config_from_args(args)
+    # include_remainder=False — see drivers/federated_vae.py
     data = FederatedCifar10(
         K=cfg.K, batch=cfg.default_batch, biased_input=cfg.biased_input,
-        drop_last_sample=cfg.drop_last_sample, data_dir=cfg.data_dir,
+        drop_last_sample=cfg.drop_last_sample, include_remainder=False,
+        data_dir=cfg.data_dir,
         limit_per_client=args.n_train, limit_test=args.n_test)
     model = AutoEncoderCNNCL(K=args.Kc, L=args.Lc)
     trainer = VAECLTrainer(model, cfg, data, FedAvg())
     print(f"federated_vae_cl: K={cfg.K} Kc={args.Kc} Lc={args.Lc} "
           f"devices={trainer.D} data={data.source}")
     state = common.maybe_load(trainer, "federated_vae_cl")
-    state, history = trainer.run(state)
+    ck = (common.checkpoint_path(cfg, "federated_vae_cl_midrun")
+          if cfg.midrun_checkpoint else None)
+    state, history = trainer.run(state, checkpoint_path=ck,
+                                 resume=cfg.load_model and ck is not None)
     print("Finished Training")
     common.finish(trainer, state, "federated_vae_cl", history)
     return state, history

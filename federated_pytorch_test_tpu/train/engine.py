@@ -22,6 +22,7 @@ Nloop sweeps).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Callable, Dict, List, NamedTuple, Optional
 
 import jax
@@ -62,11 +63,12 @@ class ClientState(NamedTuple):
     opt_state: Any
 
 
-def _normalize_u8(x_u8: jnp.ndarray, mean: jnp.ndarray) -> jnp.ndarray:
-    """Device-side ToTensor+Normalize (federated_multi.py:62-71): mean is the
-    client's [3] vector, std fixed at 0.5."""
+def _normalize_u8(x_u8: jnp.ndarray, norm: jnp.ndarray) -> jnp.ndarray:
+    """Device-side ToTensor+Normalize (federated_multi.py:62-71): ``norm`` is
+    the client's [2, 3] (mean, std) — the reference biases BOTH Normalize
+    arguments with the same per-client triple (federated_multi.py:66)."""
     x = x_u8.astype(jnp.float32) / 255.0
-    return (x - mean) / 0.5
+    return (x - norm[0]) / norm[1]
 
 
 class BlockwiseFederatedTrainer:
@@ -143,14 +145,15 @@ class BlockwiseFederatedTrainer:
         self._fn_cache: Dict[Any, Any] = {}
         self._shuffle = np.random.default_rng(cfg.seed)
 
-        # test set staged once: uint8 replicated across the mesh, labels
-        # replicated, per-client normalisation means sharded
+        # test set staged once: uint8 replicated across the mesh, labels and
+        # pad weights replicated, per-client normalisation stats sharded
         rsh = replicated_sharding(mesh)
-        xt_u8, yt = data.test_batches_raw()
+        xt_u8, yt, wt = data.test_batches_raw()
         self.test_x = jax.device_put(xt_u8, rsh)     # [tsteps, B, 32,32,3] u8
         self.test_y = jax.device_put(yt, rsh)        # [tsteps, B] i32
-        self.client_mean = jax.device_put(
-            jnp.asarray(data.means, jnp.float32), csh  # [K, 3]
+        self.test_w = jax.device_put(wt, rsh)        # [tsteps, B] f32
+        self.client_norm = jax.device_put(
+            jnp.asarray(data.norm_stats, jnp.float32), csh  # [K, 2, 3]
         )
 
     # ------------------------------------------------------------------
@@ -189,14 +192,18 @@ class BlockwiseFederatedTrainer:
             return (self.cfg.lambda1, self.cfg.lambda2)
         return (0.0, 0.0)
 
-    def model_loss(self, p, bs, xb, yb, rng):
+    def model_loss(self, p, bs, xb, yb, wb, rng):
         """Per-batch core loss -> (scalar, new_batch_stats).
 
         Classifier default: CE on logits (federated_multi.py:178-189).
-        Subclasses override for VAE/VAE-CL losses.
+        ``wb`` [B] marks pad rows of the final partial minibatch with 0
+        (drop_last=False parity); the weighted mean equals the reference's
+        mean over the true partial batch.  Subclasses override for
+        VAE/VAE-CL losses (their CIFAR pipelines run full batches only —
+        see drivers/federated_vae.py — so they ignore ``wb``).
         """
         logits, new_bs = self._apply_train(p, bs, xb)
-        return self.loss_fn(logits, yb), new_bs
+        return self.loss_fn(logits, yb, wb), new_bs
 
     def _apply_train(self, p, bs, xb):
         if self.has_bn:
@@ -231,8 +238,8 @@ class BlockwiseFederatedTrainer:
         model_loss = self.model_loss
         K = cfg.K
 
-        def batch_loss(p, bs, xb, yb, rng, z, y, rho):
-            loss, new_bs = model_loss(p, bs, xb, yb, rng)
+        def batch_loss(p, bs, xb, yb, wb, rng, z, y, rho):
+            loss, new_bs = model_loss(p, bs, xb, yb, wb, rng)
             xflat = codec.get_trainable_values(p, order, mask)
             loss = loss + algo.penalty(xflat, z, y, rho)
             if reg_on:
@@ -251,9 +258,9 @@ class BlockwiseFederatedTrainer:
 
         def adam_step(carry, batch):
             p, bs, os = carry
-            xb_u8, yb, rng, z, y, rho, mean = batch
-            xb = _normalize_u8(xb_u8, mean)
-            (loss, new_bs), g = grad_fn(p, bs, xb, yb, rng, z, y, rho)
+            xb_u8, yb, wb, rng, z, y, rho, norm = batch
+            xb = _normalize_u8(xb_u8, norm)
+            (loss, new_bs), g = grad_fn(p, bs, xb, yb, wb, rng, z, y, rho)
             g = mask_grads(g)
             updates, os = tx.update(g, os, p)
             p = optax.apply_updates(p, updates)
@@ -265,12 +272,12 @@ class BlockwiseFederatedTrainer:
             # here the closure is a pure flat-vector objective on the active
             # block and step() runs bounded line searches inside jit
             p, bs, os = carry
-            xb_u8, yb, rng, z, y, rho, mean = batch
-            xb = _normalize_u8(xb_u8, mean)
+            xb_u8, yb, wb, rng, z, y, rho, norm = batch
+            xb = _normalize_u8(xb_u8, norm)
 
             def flat_loss(v):
                 pv = codec.put_trainable_values(p, order, mask, v)
-                loss, _ = batch_loss(pv, bs, xb, yb, rng, z, y, rho)
+                loss, _ = batch_loss(pv, bs, xb, yb, wb, rng, z, y, rho)
                 return loss
 
             xflat = codec.get_trainable_values(p, order, mask)
@@ -279,21 +286,23 @@ class BlockwiseFederatedTrainer:
 
         local_step = lbfgs_step if use_lbfgs else adam_step
 
-        def per_client_epoch(p, bs, os, y, mean, key, xb_u8, yb, z, rho):
+        def per_client_epoch(p, bs, os, y, norm, key, xb_u8, yb, wb, z, rho):
             steps = xb_u8.shape[0]
             def step(carry, batch):
-                xb_u8, yb, i = batch
+                xb_u8, yb, wb, i = batch
                 rng = jax.random.fold_in(key, i)
-                return local_step(carry, (xb_u8, yb, rng, z, y, rho, mean))
+                return local_step(carry, (xb_u8, yb, wb, rng, z, y, rho, norm))
             (p, bs, os), losses = lax.scan(
-                step, (p, bs, os), (xb_u8, yb, jnp.arange(steps)))
+                step, (p, bs, os), (xb_u8, yb, wb, jnp.arange(steps)))
             return p, bs, os, jnp.sum(losses)
 
-        def epoch_shard(state: ClientState, y, mean, keys, xb_u8, yb, z, rho):
+        def epoch_shard(state: ClientState, y, norm, keys, xb_u8, yb, wb, z,
+                        rho):
             p, bs, os, loss = jax.vmap(
-                per_client_epoch, in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None, None)
-            )(state.params, state.batch_stats, state.opt_state, y, mean, keys,
-              xb_u8, yb, z, rho)
+                per_client_epoch,
+                in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0, None, None)
+            )(state.params, state.batch_stats, state.opt_state, y, norm, keys,
+              xb_u8, yb, wb, z, rho)
             return ClientState(p, bs, os), loss
 
         def comm_shard(state: ClientState, z, y, rho, x0, yhat0, mode):
@@ -327,7 +336,7 @@ class BlockwiseFederatedTrainer:
                 epoch_shard,
                 mesh=self.mesh,
                 in_specs=(state_specs, spec_c, spec_c, spec_c, spec_c, spec_c,
-                          spec_r, spec_r),
+                          spec_c, spec_r, spec_r),
                 out_specs=(state_specs, spec_c),
                 check_vma=False,
             )
@@ -385,10 +394,11 @@ class BlockwiseFederatedTrainer:
                 {"params": p, "batch_stats": bs}, xb, train=False)
         return self.model.apply({"params": p}, xb, train=False)
 
-    def eval_batch_metric(self, p, bs, xb, yb):
-        """Per-test-batch accumulated metric (classifier: correct count)."""
+    def eval_batch_metric(self, p, bs, xb, yb, wb):
+        """Per-test-batch accumulated metric (classifier: correct count;
+        pad rows of the wrap-padded final test batch carry weight 0)."""
         logits = self._apply_eval(p, bs, xb)
-        return accuracy_count(logits, yb).astype(jnp.float32)
+        return accuracy_count(logits, yb, wb).astype(jnp.float32)
 
     def eval_finalize(self, totals: np.ndarray, n_samples: int) -> np.ndarray:
         """Classifier: percent accuracy (federated_multi.py:121)."""
@@ -400,16 +410,17 @@ class BlockwiseFederatedTrainer:
             return self._fn_cache[key]
         metric = self.eval_batch_metric
 
-        def per_client(p, bs, mean, xt_u8, yt):
+        def per_client(p, bs, norm, xt_u8, yt, wt):
             def step(acc, batch):
-                xb_u8, yb = batch
-                return acc + metric(p, bs, _normalize_u8(xb_u8, mean), yb), None
-            acc, _ = lax.scan(step, jnp.float32(0), (xt_u8, yt))
+                xb_u8, yb, wb = batch
+                return acc + metric(p, bs, _normalize_u8(xb_u8, norm), yb,
+                                    wb), None
+            acc, _ = lax.scan(step, jnp.float32(0), (xt_u8, yt, wt))
             return acc
 
-        def eval_shard(params, batch_stats, mean, xt_u8, yt):
-            return jax.vmap(per_client, in_axes=(0, 0, 0, None, None))(
-                params, batch_stats, mean, xt_u8, yt
+        def eval_shard(params, batch_stats, norm, xt_u8, yt, wt):
+            return jax.vmap(per_client, in_axes=(0, 0, 0, None, None, None))(
+                params, batch_stats, norm, xt_u8, yt, wt
             )
 
         spec_c = P(CLIENT_AXIS)
@@ -417,7 +428,7 @@ class BlockwiseFederatedTrainer:
             shard_map(
                 eval_shard,
                 mesh=self.mesh,
-                in_specs=(spec_c, spec_c, spec_c, P(), P()),
+                in_specs=(spec_c, spec_c, spec_c, P(), P(), P()),
                 out_specs=spec_c,
                 check_vma=False,
             )
@@ -430,17 +441,21 @@ class BlockwiseFederatedTrainer:
     # ------------------------------------------------------------------
     def evaluate(self, state: ClientState) -> np.ndarray:
         """Per-client metric over the full test set — classifier default is
-        top-1 accuracy %, verification_error_check (federated_multi.py:108-121)."""
+        top-1 accuracy %, verification_error_check (federated_multi.py:108-121).
+        All 10k test images count: the wrap-padded remainder batch is
+        weighted out, so the divisor is the true sample count."""
         fn = self._build_eval()
-        totals = fn(state.params, state.batch_stats, self.client_mean,
-                    self.test_x, self.test_y)
-        total = self.test_y.shape[0] * self.test_y.shape[1]
+        totals = fn(state.params, state.batch_stats, self.client_norm,
+                    self.test_x, self.test_y, self.test_w)
+        total = int(np.sum(np.asarray(self.test_w)))
         return self.eval_finalize(np.asarray(totals), total)
 
     def _stage_epoch(self):
-        xb, yb = self.data.epoch_batches_raw(int(self._shuffle.integers(2**31)))
+        xb, yb, wb = self.data.epoch_batches_raw(
+            int(self._shuffle.integers(2**31)))
         sh = client_sharding(self.mesh)
-        return jax.device_put(xb, sh), jax.device_put(yb, sh)
+        return (jax.device_put(xb, sh), jax.device_put(yb, sh),
+                jax.device_put(wb, sh))
 
     def _epoch_keys(self):
         """Per-client PRNG keys [K, 2] for this epoch (reparam sampling —
@@ -453,13 +468,111 @@ class BlockwiseFederatedTrainer:
     def init_state(self) -> ClientState:
         return ClientState(self.params0, self.batch_stats0, None)
 
+    # ------------------------------------------------------------------
+    # mid-run checkpoint / resume (SURVEY.md section 5 "actually resumable
+    # mid-run").  The reference can only restart from its end-of-run
+    # s<k>.model files (federated_multi.py:99-103, :226-233); here every
+    # communication round checkpoints params + batch_stats + optimizer
+    # state + the ADMM block variables (z, y, rho, BB state) + loop
+    # counters + the host shuffle PRNG, so a killed run resumes at the
+    # exact round with a bit-identical trajectory.
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _midrun_slot(path: str) -> Optional[str]:
+        """The newest valid on-disk checkpoint among the swap slots.
+
+        ``_save_midrun`` writes to ``path.next`` then swaps it into
+        ``path`` (old copy parked at ``path.old``), so a kill at any point
+        leaves at least one complete checkpoint: orbax itself finalizes a
+        save atomically (tmp dir + rename), and the swap only removes the
+        previous copy after the new one is complete.
+        """
+        for cand in (path, path + ".next", path + ".old"):
+            if os.path.isdir(os.path.abspath(os.path.expanduser(cand))):
+                return cand
+        return None
+
+    def _save_midrun(self, path, state: ClientState, blockvars, nxt,
+                     history) -> None:
+        import pickle
+        import shutil
+
+        from federated_pytorch_test_tpu.utils.checkpoint import save_checkpoint
+
+        nloop, ci, nadmm = nxt
+        mid_block = nadmm > 0
+        tree = {"params": state.params, "batch_stats": state.batch_stats}
+        if mid_block:   # block vars only meaningful while inside a block
+            # flat leaf list: orbax round-trips optax/LBFGS NamedTuple
+            # states as plain dicts, so the structure is rebuilt on restore
+            # from a freshly init'd template (leaf order is deterministic)
+            tree["opt_state_leaves"] = list(jax.tree.leaves(state.opt_state))
+            tree.update(zip(("z", "y", "rho", "x0", "yhat0"), blockvars))
+        meta = {
+            "nloop": nloop, "ci": ci, "nadmm": nadmm,
+            "mid_block": int(mid_block),
+            "rng": np.frombuffer(
+                pickle.dumps(self._shuffle.bit_generator.state), np.uint8),
+            "history": np.frombuffer(pickle.dumps(history), np.uint8),
+        }
+        # crash-safe swap: never delete the only complete checkpoint while
+        # the replacement is still being written (see _midrun_slot)
+        ab = lambda p: os.path.abspath(os.path.expanduser(p))
+        nxt_path, old_path = path + ".next", path + ".old"
+        shutil.rmtree(ab(nxt_path), ignore_errors=True)
+        save_checkpoint(nxt_path, tree, meta)
+        shutil.rmtree(ab(old_path), ignore_errors=True)
+        if os.path.isdir(ab(path)):
+            os.rename(ab(path), ab(old_path))
+        os.rename(ab(nxt_path), ab(path))
+        shutil.rmtree(ab(old_path), ignore_errors=True)
+
+    def _restore_midrun(self, path):
+        import pickle
+
+        from federated_pytorch_test_tpu.utils.checkpoint import load_checkpoint
+
+        tree, meta = load_checkpoint(path)
+        csh = client_sharding(self.mesh)
+        rsh = jax.sharding.NamedSharding(self.mesh, P())
+        put_c = lambda t: jax.tree.map(lambda x: jax.device_put(x, csh), t)
+        put_r = lambda t: jax.tree.map(lambda x: jax.device_put(x, rsh), t)
+        mid = bool(meta["mid_block"])
+        params = put_c(tree["params"])
+        opt = None
+        blockvars = None
+        if mid:
+            _, _, init_opt = self._build_fns(int(meta["ci"]))
+            template = init_opt(params)
+            leaves = [tree["opt_state_leaves"][k] for k in
+                      sorted(tree["opt_state_leaves"],
+                             key=int)] if isinstance(
+                tree["opt_state_leaves"], dict) else tree["opt_state_leaves"]
+            opt = put_c(jax.tree.unflatten(jax.tree.structure(template),
+                                           leaves))
+            blockvars = (put_r(tree["z"]), put_c(tree["y"]),
+                         put_r(tree["rho"]), put_c(tree["x0"]),
+                         put_c(tree["yhat0"]))
+        state = ClientState(params, put_c(tree["batch_stats"]), opt)
+        self._shuffle.bit_generator.state = pickle.loads(
+            np.asarray(meta["rng"], np.uint8).tobytes())
+        history = pickle.loads(np.asarray(meta["history"], np.uint8).tobytes())
+        return state, blockvars, (int(meta["nloop"]), int(meta["ci"]),
+                                  int(meta["nadmm"]), mid), history
+
     def run(
         self,
         state: Optional[ClientState] = None,
         log: Callable[[str], None] = print,
         on_round: Optional[Callable[..., None]] = None,
+        checkpoint_path: Optional[str] = None,
+        resume: bool = False,
     ):
         """The full loop nest.  Returns (state, history).
+
+        ``checkpoint_path``: save a resumable mid-run checkpoint after every
+        communication round.  ``resume=True`` (with an existing checkpoint)
+        restores it and continues at the exact next round.
 
         ``history`` records per communication round: block, residuals, rho,
         and per-client accuracies (when cfg.check_results).
@@ -470,34 +583,64 @@ class BlockwiseFederatedTrainer:
         csh = client_sharding(self.mesh)
         rsh = jax.sharding.NamedSharding(self.mesh, P())
 
+        resume_at = None
+        slot = (self._midrun_slot(checkpoint_path)
+                if resume and checkpoint_path is not None else None)
+        if slot is not None:
+            state, r_blockvars, resume_at, history = self._restore_midrun(
+                slot)
+            log(f"resumed mid-run checkpoint {slot} at "
+                f"(nloop, block, nadmm)={resume_at[:3]}")
+
         for nloop in range(cfg.Nloop):
             for ci in range(self.L):
+                if resume_at is not None and (nloop, ci) < resume_at[:2]:
+                    continue
                 train_epoch, comm_fns, init_opt = self._build_fns(ci)
                 N = self.block_size(ci)
-                # fresh per-block state (federated_multi.py:148-159)
-                z = jax.device_put(jnp.zeros((N,), jnp.float32), rsh)
-                ydim = N if algo.needs_dual else 1
-                y = jax.device_put(jnp.zeros((cfg.K, ydim), jnp.float32), csh)
-                rho = jax.device_put(jnp.float32(cfg.admm_rho0), rsh)
-                x0 = jax.device_put(jnp.zeros((cfg.K, N if cfg.bb_update else 1),
-                                              jnp.float32), csh)
-                # yhat0 init = params at block start (consensus_multi.py:184)
-                if cfg.bb_update:
-                    yhat0 = self._build_gather(ci)(state.params)
+                nadmm_start = 0
+                if (resume_at is not None and (nloop, ci) == resume_at[:2]
+                        and resume_at[3]):
+                    # resume inside this block: restored z/y/rho/BB/opt state
+                    z, y, rho, x0, yhat0 = r_blockvars
+                    nadmm_start = resume_at[2]
+                    resume_at = None
                 else:
-                    yhat0 = jax.device_put(
-                        jnp.zeros((cfg.K, 1), jnp.float32), csh)
-                state = ClientState(state.params, state.batch_stats,
-                                    init_opt(state.params))
+                    resume_at = None
+                    # fresh per-block state (federated_multi.py:148-159)
+                    z = jax.device_put(jnp.zeros((N,), jnp.float32), rsh)
+                    ydim = N if algo.needs_dual else 1
+                    y = jax.device_put(
+                        jnp.zeros((cfg.K, ydim), jnp.float32), csh)
+                    rho = jax.device_put(jnp.float32(cfg.admm_rho0), rsh)
+                    x0 = jax.device_put(
+                        jnp.zeros((cfg.K, N if cfg.bb_update else 1),
+                                  jnp.float32), csh)
+                    # yhat0 init = params at block start (consensus_multi.py:184)
+                    if cfg.bb_update:
+                        yhat0 = self._build_gather(ci)(state.params)
+                    else:
+                        yhat0 = jax.device_put(
+                            jnp.zeros((cfg.K, 1), jnp.float32), csh)
+                    state = ClientState(state.params, state.batch_stats,
+                                        init_opt(state.params))
 
-                for nadmm in range(cfg.Nadmm):
+                for nadmm in range(nadmm_start, cfg.Nadmm):
                     loss_sum = 0.0
-                    for _ in range(cfg.Nepoch):
-                        xb, yb = self._stage_epoch()
+                    for nepoch in range(cfg.Nepoch):
+                        xb, yb, wb = self._stage_epoch()
                         state, losses = train_epoch(
-                            state, y, self.client_mean, self._epoch_keys(),
-                            xb, yb, z, rho)
+                            state, y, self.client_norm, self._epoch_keys(),
+                            xb, yb, wb, z, rho)
                         loss_sum += float(np.sum(np.asarray(losses)))
+                        if cfg.be_verbose:
+                            # per-client epoch losses (the reference's
+                            # be_verbose minibatch prints,
+                            # federated_multi.py:199-200)
+                            log(f"verbose: block={ci} nadmm={nadmm} "
+                                f"epoch={nepoch} client_loss="
+                                + np.array2string(np.asarray(losses),
+                                                  precision=4))
                     if algo.communicates:
                         if cfg.bb_update and nadmm == 0:
                             mode = "bb_store"
@@ -516,6 +659,16 @@ class BlockwiseFederatedTrainer:
                     if cfg.check_results:
                         rec["accuracy"] = self.evaluate(state)
                     history.append(rec)
+                    if checkpoint_path is not None:
+                        if nadmm + 1 < cfg.Nadmm:
+                            nxt = (nloop, ci, nadmm + 1)
+                        elif ci + 1 < self.L:
+                            nxt = (nloop, ci + 1, 0)
+                        else:
+                            nxt = (nloop + 1, 0, 0)
+                        self._save_midrun(checkpoint_path, state,
+                                          (z, y, rho, x0, yhat0), nxt,
+                                          history)
                     blk = self.block_ids[ci]
                     msg = (f"block=[{blk[0]},{blk[1]}]({N},{float(rho):f}) "
                            f"round={nadmm}/{nloop} "
@@ -543,9 +696,10 @@ class BlockwiseFederatedTrainer:
         for epoch in range(cfg.Nepoch):
             state = ClientState(state.params, state.batch_stats,
                                 init_opt(state.params))
-            xb, yb = self._stage_epoch()
-            state, losses = train_epoch(state, y, self.client_mean,
-                                        self._epoch_keys(), xb, yb, z, rho)
+            xb, yb, wb = self._stage_epoch()
+            state, losses = train_epoch(state, y, self.client_norm,
+                                        self._epoch_keys(), xb, yb, wb, z,
+                                        rho)
             rec = dict(epoch=epoch, loss=float(np.sum(np.asarray(losses))))
             if cfg.check_results:
                 rec["accuracy"] = self.evaluate(state)

@@ -10,10 +10,20 @@ import jax.numpy as jnp
 import optax
 
 
-def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
+                  weights: jnp.ndarray = None) -> jnp.ndarray:
     """Mean softmax cross-entropy — torch ``nn.CrossEntropyLoss`` default
-    reduction (federated_multi.py:130-132)."""
-    return jnp.mean(optax.softmax_cross_entropy_with_integer_labels(logits, labels))
+    reduction (federated_multi.py:130-132).
+
+    ``weights`` (0/1 per sample) implements the padded final minibatch
+    (DataLoader drop_last=False, federated_multi.py:74-83): the weighted
+    mean over the real rows equals the reference's mean over the partial
+    batch.
+    """
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    if weights is None:
+        return jnp.mean(ce)
+    return jnp.sum(ce * weights) / jnp.maximum(jnp.sum(weights), 1.0)
 
 
 def l1_l2(x: jnp.ndarray, lambda1: float, lambda2: float) -> jnp.ndarray:
@@ -22,7 +32,11 @@ def l1_l2(x: jnp.ndarray, lambda1: float, lambda2: float) -> jnp.ndarray:
     return lambda1 * jnp.sum(jnp.abs(x)) + lambda2 * jnp.vdot(x, x)
 
 
-def accuracy_count(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+def accuracy_count(logits: jnp.ndarray, labels: jnp.ndarray,
+                   weights: jnp.ndarray = None) -> jnp.ndarray:
     """Number of correct top-1 predictions (verification_error_check,
-    federated_multi.py:108-121)."""
-    return jnp.sum(jnp.argmax(logits, axis=-1) == labels)
+    federated_multi.py:108-121); pad rows (weight 0) excluded."""
+    correct = jnp.argmax(logits, axis=-1) == labels
+    if weights is None:
+        return jnp.sum(correct)
+    return jnp.sum(correct * weights)

@@ -38,11 +38,14 @@ class VAETrainer(BlockwiseFederatedTrainer):
     def reg_for_block(self, ci):
         return (0.0, 0.0)
 
-    def model_loss(self, p, bs, xb, yb, rng):
+    def model_loss(self, p, bs, xb, yb, wb, rng):
+        # wb unused: the VAE drivers construct FederatedCifar10 with
+        # include_remainder=False (sum-reduction losses have no per-sample
+        # decomposition in the reference either, federated_vae.py:96-108)
         recon, mu, logvar = self.model.apply({"params": p}, xb, rng)
         return vae_loss(recon, xb, mu, logvar), bs
 
-    def eval_batch_metric(self, p, bs, xb, yb):
+    def eval_batch_metric(self, p, bs, xb, yb, wb):
         # fixed key: deterministic eval ELBO
         recon, mu, logvar = self.model.apply(
             {"params": p}, xb, jax.random.PRNGKey(0))
@@ -81,13 +84,14 @@ class VAECLTrainer(BlockwiseFederatedTrainer):
     def reg_for_block(self, ci):
         return (0.0, self.cfg.lambda2)   # unconditional L2 (:228-230)
 
-    def model_loss(self, p, bs, xb, yb, rng):
+    def model_loss(self, p, bs, xb, yb, wb, rng):
+        # wb unused — see VAETrainer.model_loss
         out = self.model.apply({"params": p}, xb, rng, reparam=True)
         ekhat, mu_xi, sig2_xi, mu_b, sig2_b, mu_th, sig2_th = out
         return vae_cl_loss(ekhat, mu_xi, sig2_xi, mu_b, sig2_b,
                            mu_th, sig2_th, xb), bs
 
-    def eval_batch_metric(self, p, bs, xb, yb):
+    def eval_batch_metric(self, p, bs, xb, yb, wb):
         out = self.model.apply({"params": p}, xb, jax.random.PRNGKey(0),
                                reparam=True)
         ekhat, mu_xi, sig2_xi, mu_b, sig2_b, mu_th, sig2_th = out

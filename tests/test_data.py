@@ -6,6 +6,7 @@ import pytest
 from federated_pytorch_test_tpu.data.cifar10 import (
     FederatedCifar10,
     client_means,
+    client_norm_stats,
     normalize,
     shard_indices,
 )
@@ -47,6 +48,22 @@ class TestTransforms:
         out = normalize(x, (0.5, 0.5, 0.5))
         np.testing.assert_allclose(out.ravel(), [-1.0, 0.0, 1.0], atol=0.01)
 
+    def test_biased_std_matches_mean(self):
+        # the reference biases BOTH Normalize args with the same triple
+        # (federated_multi.py:66): Normalize((.5+k/100,...),(.5+k/100,...))
+        norm = client_norm_stats(4, biased_input=True)
+        assert norm.shape == (4, 2, 3)
+        np.testing.assert_allclose(norm[:, 0], norm[:, 1])
+        np.testing.assert_allclose(norm[3, 1], [0.53, 0.47, 0.5], atol=1e-6)
+
+    def test_normalize_uses_biased_std(self):
+        x = np.full((1, 3), 255, dtype=np.uint8)
+        out = normalize(x, (0.53, 0.47, 0.5))   # std defaults to mean
+        np.testing.assert_allclose(
+            out.ravel(),
+            [(1 - 0.53) / 0.53, (1 - 0.47) / 0.47, (1 - 0.5) / 0.5],
+            rtol=1e-5)
+
 
 class TestFederatedCifar10:
     @pytest.fixture(scope="class")
@@ -55,19 +72,46 @@ class TestFederatedCifar10:
                                limit_test=64)
 
     def test_shapes(self, data):
-        xb, yb = data.epoch_batches_raw(seed=0)
+        xb, yb, wb = data.epoch_batches_raw(seed=0)
         assert xb.shape == (4, 4, 16, 32, 32, 3) and xb.dtype == np.uint8
         assert yb.shape == (4, 4, 16) and yb.dtype == np.int32
+        assert wb.shape == (4, 4, 16)
+        np.testing.assert_allclose(wb, 1.0)    # 64 % 16 == 0: no pad rows
 
     def test_epoch_reshuffles(self, data):
-        x0, _ = data.epoch_batches_raw(seed=0)
-        x1, _ = data.epoch_batches_raw(seed=1)
+        x0, _, _ = data.epoch_batches_raw(seed=0)
+        x1, _, _ = data.epoch_batches_raw(seed=1)
         assert not np.array_equal(x0, x1)
 
     def test_test_batches_raw_single_copy(self, data):
-        xt, yt = data.test_batches_raw()
+        xt, yt, wt = data.test_batches_raw()
         assert xt.shape == (4, 16, 32, 32, 3)  # no client axis
         assert yt.shape == (4, 16)
+        np.testing.assert_allclose(wt, 1.0)
+
+    def test_remainder_batch_padded_and_weighted(self):
+        # 50 samples, batch 16 -> 3 full + 1 partial batch of 2 (torch
+        # DataLoader drop_last=False parity, federated_multi.py:74-83)
+        d = FederatedCifar10(K=2, batch=16, limit_per_client=50,
+                             limit_test=40)
+        assert d.steps == 4 and d.remainder == 2
+        xb, yb, wb = d.epoch_batches_raw(seed=0)
+        assert xb.shape == (2, 4, 16, 32, 32, 3)
+        np.testing.assert_allclose(wb[:, :3], 1.0)
+        np.testing.assert_allclose(wb[:, 3, :2], 1.0)
+        np.testing.assert_allclose(wb[:, 3, 2:], 0.0)
+        # test set 40, batch 16 -> 3 batches, last 8 rows are pad
+        xt, yt, wt = d.test_batches_raw()
+        assert xt.shape == (3, 16, 32, 32, 3)
+        assert float(wt.sum()) == 40.0
+
+    def test_remainder_disabled_truncates(self):
+        d = FederatedCifar10(K=2, batch=16, limit_per_client=50,
+                             limit_test=40, include_remainder=False)
+        assert d.steps == 3 and d.remainder == 0
+        xb, _, wb = d.epoch_batches_raw(seed=0)
+        assert xb.shape[1] == 3
+        np.testing.assert_allclose(wb, 1.0)
 
     def test_disjoint_client_shards(self):
         d = FederatedCifar10(K=2, batch=8, limit_per_client=32)
