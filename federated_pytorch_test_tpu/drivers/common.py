@@ -59,6 +59,24 @@ def config_from_args(args: argparse.Namespace) -> FederatedConfig:
     return FederatedConfig(**kw)
 
 
+def apply_platform(cfg: FederatedConfig) -> None:
+    """Honor ``use_tpu`` (the reference's ``use_cuda`` gate,
+    federated_multi.py:32): when False, run on the host CPU platform.
+    Must be called before the first JAX device query; if the backend is
+    already initialized on a non-CPU platform, warns instead of failing.
+    """
+    if cfg.use_tpu:
+        return
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError as e:                     # backend already up
+        import warnings
+        warnings.warn(f"--no-use-tpu requested but the JAX backend is "
+                      f"already initialized ({e}); continuing on the "
+                      "existing platform")
+
+
 def make_trainer(cfg: FederatedConfig, algorithm: Algorithm,
                  n_train: Optional[int] = None,
                  n_test: Optional[int] = None) -> BlockwiseFederatedTrainer:
@@ -77,12 +95,17 @@ def checkpoint_path(cfg: FederatedConfig, name: str) -> str:
 
 
 def finish(trainer: BlockwiseFederatedTrainer, state, name: str, history):
-    """Save the end-of-run checkpoint (reference federated_multi.py:226-233)."""
+    """Save the end-of-run checkpoint (reference federated_multi.py:226-233).
+
+    Saves the optimizer state of the final block alongside the model, as the
+    reference does (:231 stores optimizer.state_dict()); like the reference,
+    ``maybe_load`` restores model variables only (:99-103)."""
     cfg = trainer.cfg
     if cfg.save_model:
         meta = {"rounds": len(history)}
-        save_checkpoint(checkpoint_path(cfg, name), state._asdict() | {
-            "opt_state": ()}, meta)  # opt state is per-block; not carried over
+        opt_state = state.opt_state if state.opt_state is not None else ()
+        save_checkpoint(checkpoint_path(cfg, name),
+                        state._asdict() | {"opt_state": opt_state}, meta)
         print(f"saved checkpoint -> {checkpoint_path(cfg, name)}")
 
 
@@ -110,6 +133,7 @@ def run_classifier_driver(prog: str, defaults: FederatedConfig,
                           argv=None):
     args = build_parser(defaults, prog).parse_args(argv)
     cfg = config_from_args(args)
+    apply_platform(cfg)
     trainer = make_trainer(cfg, algorithm, args.n_train, args.n_test)
     print(f"{prog}: K={cfg.K} model={'ResNet18' if cfg.use_resnet else 'Net'} "
           f"devices={trainer.D} clients/device={trainer.K_local} "
@@ -118,7 +142,10 @@ def run_classifier_driver(prog: str, defaults: FederatedConfig,
     if independent:
         state, history = trainer.run_independent(state)
     else:
-        state, history = trainer.run(state)
+        ck = (checkpoint_path(cfg, prog + "_midrun")
+              if cfg.midrun_checkpoint else None)
+        state, history = trainer.run(state, checkpoint_path=ck,
+                                     resume=cfg.load_model and ck is not None)
     print("Finished Training")
     finish(trainer, state, prog, history)
     return state, history

@@ -33,11 +33,23 @@ def client_mesh(num_devices: Optional[int] = None,
 
 
 def usable_device_count(K: int, mesh_or_devices=None) -> int:
-    """Largest device count D <= len(devices) with K % D == 0."""
+    """Largest device count D <= len(devices) with K % D == 0.
+
+    Warns when the divisibility constraint collapses the mesh to far fewer
+    devices than available (e.g. prime K=13 on 8 chips -> D=1): all clients
+    then run vmapped on one chip, an ~n/D throughput cliff that is
+    otherwise silent.
+    """
     n = len(jax.devices() if mesh_or_devices is None else mesh_or_devices)
     d = min(n, K)
     while K % d:
         d -= 1
+    if n > 1 and d <= n // 2 and K > d:
+        import warnings
+        warnings.warn(
+            f"K={K} clients only divide onto {d} of {n} available devices; "
+            f"choose K a multiple of the device count (or pass num_devices) "
+            "to use the full mesh", stacklevel=2)
     return d
 
 

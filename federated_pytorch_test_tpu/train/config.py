@@ -65,6 +65,11 @@ class FederatedConfig:
 
     # checkpointing
     checkpoint_dir: str = "./checkpoints"
+    # save a resumable checkpoint after EVERY communication round (params +
+    # opt state + ADMM/BB block vars + loop counters + host PRNG); resume
+    # with --load-model.  Beyond the reference, which only restarts from its
+    # end-of-run s<k>.model files (federated_multi.py:99-103, :226-233)
+    midrun_checkpoint: bool = False
 
     # mesh: None -> use as many devices as divide K
     num_devices: Optional[int] = None
