@@ -1,0 +1,130 @@
+"""Mid-run checkpoint/resume tests (SURVEY.md section 5).
+
+The contract: kill a run after any communication round, resume from the
+checkpoint, and the continued history/params must match an uninterrupted
+run exactly (same staging PRNG, same optimizer state, same ADMM state).
+"""
+
+import numpy as np
+import pytest
+
+from federated_pytorch_test_tpu.data.cifar10 import FederatedCifar10
+from federated_pytorch_test_tpu.models.simple import Net
+from federated_pytorch_test_tpu.train import (
+    AdmmConsensus,
+    BlockwiseFederatedTrainer,
+    FederatedConfig,
+)
+
+K = 4
+
+
+class Killed(Exception):
+    pass
+
+
+def small_cfg(**kw):
+    base = dict(K=K, Nloop=1, Nepoch=1, Nadmm=3, default_batch=8,
+                check_results=False, admm_rho0=0.1, seed=5)
+    base.update(kw)
+    return FederatedConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def data():
+    return FederatedCifar10(K=K, batch=8, limit_per_client=16, limit_test=8)
+
+
+def run_trainer(cfg, data, L=1, **run_kw):
+    t = BlockwiseFederatedTrainer(Net(), cfg, data, AdmmConsensus())
+    t.L = L
+    return t.run(log=lambda m: None, **run_kw)
+
+
+def strip(rec):
+    return {k: v for k, v in rec.items() if isinstance(v, (int, float))}
+
+
+class TestMidrunResume:
+    def test_killed_run_resumes_to_identical_history(self, data, tmp_path):
+        cfg = small_cfg()
+        ck = str(tmp_path / "ck")
+
+        _, hist_full = run_trainer(cfg, data)
+
+        def bomb(state, rec):
+            if rec["nadmm"] == 0:
+                raise Killed
+
+        with pytest.raises(Killed):
+            run_trainer(cfg, data, checkpoint_path=ck, on_round=bomb)
+
+        state_r, hist_r = run_trainer(cfg, data, checkpoint_path=ck,
+                                      resume=True)
+        assert len(hist_r) == len(hist_full)
+        # restored prefix + continued rounds must match the uninterrupted
+        # run: same shuffle PRNG state, optimizer state, and z/y/rho
+        for a, b in zip(hist_r, hist_full):
+            sa, sb = strip(a), strip(b)
+            assert sa.keys() == sb.keys()
+            for k in sa:
+                np.testing.assert_allclose(sa[k], sb[k], rtol=1e-5,
+                                           err_msg=f"history field {k}")
+
+    def test_params_match_uninterrupted(self, data, tmp_path):
+        cfg = small_cfg(Nadmm=2)
+        ck = str(tmp_path / "ck")
+        state_full, _ = run_trainer(cfg, data)
+
+        def bomb(state, rec):
+            if rec["nadmm"] == 0:
+                raise Killed
+
+        with pytest.raises(Killed):
+            run_trainer(cfg, data, checkpoint_path=ck, on_round=bomb)
+        state_r, _ = run_trainer(cfg, data, checkpoint_path=ck, resume=True)
+
+        ref = jax_to_np(state_full.params)
+        res = jax_to_np(state_r.params)
+        for (pa, a), (pb, b) in zip(ref, res):
+            assert pa == pb
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7,
+                                       err_msg=str(pa))
+
+    def test_block_boundary_resume(self, data, tmp_path):
+        # kill exactly at a block rollover: the checkpoint then carries no
+        # block vars (fresh-init path on resume) — both blocks must run
+        cfg = small_cfg(Nadmm=1)
+        ck = str(tmp_path / "ck")
+        _, hist_full = run_trainer(cfg, data, L=2)
+
+        seen = []
+
+        def bomb(state, rec):
+            seen.append(rec["block"])
+            if rec["block"] == 0:
+                raise Killed
+
+        with pytest.raises(Killed):
+            run_trainer(cfg, data, L=2, checkpoint_path=ck, on_round=bomb)
+        _, hist_r = run_trainer(cfg, data, L=2, checkpoint_path=ck,
+                                resume=True)
+        assert [h["block"] for h in hist_r] == [h["block"] for h in hist_full]
+        for a, b in zip(hist_r, hist_full):
+            np.testing.assert_allclose(a["loss"], b["loss"], rtol=1e-5)
+
+    def test_completed_run_resume_is_noop(self, data, tmp_path):
+        cfg = small_cfg(Nadmm=1)
+        ck = str(tmp_path / "ck")
+        _, hist = run_trainer(cfg, data, checkpoint_path=ck)
+        state2, hist2 = run_trainer(cfg, data, checkpoint_path=ck,
+                                    resume=True)
+        # nothing left to do: restored history returned unchanged
+        assert len(hist2) == len(hist)
+
+
+def jax_to_np(tree):
+    import jax
+
+    flat, _ = jax.tree.flatten_with_path(tree)
+    return [(jax.tree_util.keystr(p), np.asarray(v)) for p, v in flat]
