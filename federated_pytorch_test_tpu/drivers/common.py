@@ -28,7 +28,8 @@ def build_parser(defaults: FederatedConfig, prog: str) -> argparse.ArgumentParse
         description="TPU-native federated CIFAR10 driver "
                     "(reference parity: see module docstring)")
     # converters for Optional[...] fields (default None carries no type)
-    _optional_types = {"data_dir": str, "num_devices": int}
+    _optional_types = {"data_dir": str, "num_devices": int,
+                       "profile_dir": str}
     for f in dataclasses.fields(FederatedConfig):
         default = getattr(defaults, f.name)
         arg = "--" + f.name.replace("_", "-")

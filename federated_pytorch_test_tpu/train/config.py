@@ -73,3 +73,8 @@ class FederatedConfig:
 
     # mesh: None -> use as many devices as divide K
     num_devices: Optional[int] = None
+
+    # tracing/profiling (SURVEY.md section 5): when set, the run is wrapped
+    # in jax.profiler.trace(profile_dir) producing a TensorBoard/XProf
+    # trace; per-round wall-clock always lands in history["round_seconds"]
+    profile_dir: Optional[str] = None

@@ -21,8 +21,10 @@ Nloop sweeps).
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import os
+import time
 from typing import Any, Callable, Dict, List, NamedTuple, Optional
 
 import jax
@@ -560,7 +562,20 @@ class BlockwiseFederatedTrainer:
         return state, blockvars, (int(meta["nloop"]), int(meta["ci"]),
                                   int(meta["nadmm"]), mid), history
 
-    def run(
+    def _profile_ctx(self):
+        """jax.profiler trace over the run when cfg.profile_dir is set
+        (SURVEY.md section 5 tracing; TensorBoard/XProf format)."""
+        if self.cfg.profile_dir:
+            return jax.profiler.trace(
+                os.path.abspath(os.path.expanduser(self.cfg.profile_dir)))
+        return contextlib.nullcontext()
+
+    def run(self, *args, **kw):
+        """The full loop nest (see ``_run_impl``), optionally profiled."""
+        with self._profile_ctx():
+            return self._run_impl(*args, **kw)
+
+    def _run_impl(
         self,
         state: Optional[ClientState] = None,
         log: Callable[[str], None] = print,
@@ -626,6 +641,7 @@ class BlockwiseFederatedTrainer:
                                         init_opt(state.params))
 
                 for nadmm in range(nadmm_start, cfg.Nadmm):
+                    t_round = time.perf_counter()
                     loss_sum = 0.0
                     for nepoch in range(cfg.Nepoch):
                         xb, yb, wb = self._stage_epoch()
@@ -654,8 +670,12 @@ class BlockwiseFederatedTrainer:
                         diag = {k: float(v) for k, v in diag.items()}
                     else:
                         diag = {}
+                    # per-round wall-clock (epochs + collective; the float()
+                    # fetches above force a device sync so this is honest)
                     rec = dict(nloop=nloop, block=ci, nadmm=nadmm, N=N,
-                               loss=loss_sum, rho=float(rho), **diag)
+                               loss=loss_sum, rho=float(rho),
+                               round_seconds=time.perf_counter() - t_round,
+                               **diag)
                     if cfg.check_results:
                         rec["accuracy"] = self.evaluate(state)
                     history.append(rec)
@@ -685,6 +705,10 @@ class BlockwiseFederatedTrainer:
                         log: Callable[[str], None] = print):
         """`no_consensus` path: whole net trainable, Nepoch epochs, Adam
         re-created every epoch (no_consensus_multi.py:128-166), no comm."""
+        with self._profile_ctx():
+            return self._run_independent_impl(state, log)
+
+    def _run_independent_impl(self, state, log):
         cfg = self.cfg
         state = state or self.init_state()
         train_epoch, _, init_opt = self._build_fns(None)
@@ -694,13 +718,15 @@ class BlockwiseFederatedTrainer:
                            client_sharding(self.mesh))
         rho = jnp.float32(cfg.admm_rho0)
         for epoch in range(cfg.Nepoch):
+            t_epoch = time.perf_counter()
             state = ClientState(state.params, state.batch_stats,
                                 init_opt(state.params))
             xb, yb, wb = self._stage_epoch()
             state, losses = train_epoch(state, y, self.client_norm,
                                         self._epoch_keys(), xb, yb, wb, z,
                                         rho)
-            rec = dict(epoch=epoch, loss=float(np.sum(np.asarray(losses))))
+            rec = dict(epoch=epoch, loss=float(np.sum(np.asarray(losses))),
+                       epoch_seconds=time.perf_counter() - t_epoch)
             if cfg.check_results:
                 rec["accuracy"] = self.evaluate(state)
                 log(f"Epoch {epoch} acc="

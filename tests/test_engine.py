@@ -225,3 +225,23 @@ class TestCommonInit:
         for leaf in flat:
             for k in range(1, K):
                 np.testing.assert_array_equal(leaf[0], leaf[k])
+
+
+class TestTracing:
+    """SURVEY.md section 5 tracing/profiling subsystem."""
+
+    def test_round_seconds_recorded(self, data):
+        cfg = small_cfg()
+        t = BlockwiseFederatedTrainer(Net(), cfg, data, FedAvg())
+        t.L = 1
+        _, hist = t.run(log=lambda m: None)
+        assert all(h["round_seconds"] > 0 for h in hist)
+
+    def test_profile_trace_written(self, data, tmp_path):
+        cfg = small_cfg(profile_dir=str(tmp_path / "trace"))
+        t = BlockwiseFederatedTrainer(Net(), cfg, data, FedAvg())
+        t.L = 1
+        t.run(log=lambda m: None)
+        # jax.profiler.trace writes plugins/profile/<ts>/*.xplane.pb
+        hits = list((tmp_path / "trace").rglob("*.xplane.pb"))
+        assert hits, "no xplane trace written"
