@@ -15,9 +15,9 @@ ADMM-consensus ResNet18 driver), all with data staged once:
 
   * headline: local-epoch throughput on the stem block ci=0 (N=1,856) — the
     same sliver round 1/2 measured, kept for cross-round comparability;
-  * big block: the LARGEST ResNet18 partition (reference block [45,53],
-    N~2.4M of 11.2M params, resnet18_partition consensus path) — masked
-    grads + L-BFGS-free Adam epoch on a communication-heavy block;
+  * big block: the LARGEST ResNet18 partition (reference block [54,59],
+    N=4,720,640 of 11.2M params, resnet18_partition consensus path) —
+    masked grads + Adam epoch on a communication-heavy block;
   * full consensus round: Nepoch local epoch + ADMM comm round (psum
     average, dual update, z write-back).  Data is staged once and PRNG
     keys reused, so per-epoch host->device staging is NOT in this number
@@ -59,18 +59,13 @@ def _peak_flops(device) -> float:
 
 
 def main():
-    import os
+    # the bench is compile-dominated (3 block specialisations of the
+    # ResNet18 epoch); share the persistent cache across driver runs
+    from federated_pytorch_test_tpu.utils.compile_cache import (
+        enable_persistent_compile_cache,
+    )
 
-    # persistent compile cache: the bench is compile-dominated (3 block
-    # specialisations of the ResNet18 epoch); cache across driver runs
-    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         "tests", ".jax_cache")
-    try:
-        jax.config.update("jax_compilation_cache_dir", cache)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    except Exception:
-        pass
+    enable_persistent_compile_cache()
     from federated_pytorch_test_tpu.data.cifar10 import FederatedCifar10
     from federated_pytorch_test_tpu.models.resnet import ResNet18
     from federated_pytorch_test_tpu.parallel.mesh import client_sharding
@@ -141,7 +136,7 @@ def main():
         dt = time.perf_counter() - t0
         return reps * images_per_epoch / dt / n_chips
 
-    # block sizes across the sweep; biggest = reference block [45,53]
+    # block sizes across the sweep; biggest = reference block [54,59]
     sizes = [trainer.block_size(ci) for ci in range(trainer.L)]
     big_ci = int(np.argmax(sizes))
 

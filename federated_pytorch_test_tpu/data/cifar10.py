@@ -34,6 +34,7 @@ the framework trains and benchmarks end-to-end in a zero-egress environment.
 
 from __future__ import annotations
 
+import functools
 import os
 import pickle
 from dataclasses import dataclass, field
@@ -69,6 +70,7 @@ def _load_pickle_batches(dirname: str) -> Tuple[np.ndarray, np.ndarray, np.ndarr
     return np.concatenate(xs), np.concatenate(ys), xte, yte
 
 
+@functools.lru_cache(maxsize=4)
 def _synthetic_cifar10(seed: int = 0, noise: float = 48.0,
                        prototypes: int = 1
                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
@@ -104,6 +106,11 @@ def _synthetic_cifar10(seed: int = 0, noise: float = 48.0,
 
     xtr, ytr = make(TRAIN_SIZE, rng)
     xte, yte = make(TEST_SIZE, rng)
+    # lru_cached (generating 60k images costs seconds per call; tests and
+    # the comparison driver construct many pipelines) — freeze so shared
+    # arrays cannot be mutated through one consumer
+    for a in (xtr, ytr, xte, yte):
+        a.setflags(write=False)
     return xtr, ytr, xte, yte
 
 

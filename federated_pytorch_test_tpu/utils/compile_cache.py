@@ -1,0 +1,34 @@
+"""Shared persistent XLA compile-cache setup.
+
+One helper for the three compile-heavy entry surfaces (tests/conftest.py,
+__graft_entry__.py, bench.py): first compiles dominate their wall-clock, so
+they share one on-disk cache that survives across processes and rounds.
+The default location is the historical ``tests/.jax_cache`` (kept so
+existing warm entries stay valid).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def enable_persistent_compile_cache(cache_dir: Optional[str] = None) -> str:
+    """Point JAX's persistent compilation cache at ``cache_dir``.
+
+    Safe to call at any time (before or after backend init); failures are
+    swallowed because a missing cache only costs compile time.
+    """
+    if cache_dir is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        cache_dir = os.path.join(root, "tests", ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass
+    return cache_dir

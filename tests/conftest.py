@@ -28,7 +28,9 @@ jax.config.update("jax_default_matmul_precision", "float32")
 
 # persistent compilation cache: XLA:CPU compiles dominate test wall-clock;
 # cache them across pytest runs
-_cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
-jax.config.update("jax_compilation_cache_dir", _cache_dir)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+from federated_pytorch_test_tpu.utils.compile_cache import (  # noqa: E402
+    enable_persistent_compile_cache,
+)
+
+enable_persistent_compile_cache(os.path.join(os.path.dirname(__file__),
+                                             ".jax_cache"))
