@@ -146,10 +146,11 @@ def main():
 
     dev = jax.devices()[0]
     # MFU from the analytic model-FLOP count (the standard definition):
-    # CIFAR ResNet18 forward ~0.62 GMAC/image (3x3 stem, 32x32 input, four
-    # stages of ~150 MMAC each), train step ~3x forward (fwd + 2x bwd) at
+    # CIFAR ResNet18 forward ~0.56 GMAC/image (3x3 stem @32x32: 1.8 MMAC;
+    # layer1 4x 3x3x64x64 @32x32: 151 MMAC; layers2-4 ~134 MMAC each after
+    # the stride-2 downsamples), train step ~3x forward (fwd + 2x bwd) at
     # 2 FLOPs/MAC
-    step_flops_per_image = 3 * 2 * 0.62e9
+    step_flops_per_image = 3 * 2 * 0.56e9
     mfu = headline * step_flops_per_image / _peak_flops(dev)
 
     print(json.dumps({

@@ -14,6 +14,7 @@ feeds the [K, Niter, nbatch, 32, 32, 8] patch tensor per round.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
@@ -192,7 +193,9 @@ class CPCTrainer:
     def run(self, Nloop: int = 1, Nadmm: int = 1,
             state: Optional[CPCState] = None,
             log: Callable[[str], None] = print):
-        """The rotation loop (federated_cpc.py:194-304)."""
+        """The rotation loop (federated_cpc.py:194-304).  History records
+        carry per-round wall-clock (round_seconds) like the classifier
+        engine (SURVEY.md section 5 tracing)."""
         state = state or self.state0
         history: List[Dict[str, Any]] = []
         csh = client_sharding(self.mesh)
@@ -202,6 +205,7 @@ class CPCTrainer:
                 for ci in range(len(blocks)):
                     z = opt_state = None
                     for nadmm in range(Nadmm):
+                        t_round = time.perf_counter()
                         px, py, batch = self.data.round_batches(self.Niter)
                         fn, init_fn, N = self._build_round(mdl, ci, px, py)
                         if z is None:
@@ -212,7 +216,9 @@ class CPCTrainer:
                         rec = dict(nloop=nloop, model=mdl, block=ci,
                                    nadmm=nadmm, N=N,
                                    dual_residual=float(dual),
-                                   loss=float(np.sum(np.asarray(losses))))
+                                   loss=float(np.sum(np.asarray(losses))),
+                                   round_seconds=(time.perf_counter()
+                                                  - t_round))
                         history.append(rec)
                         log(f"dual (N={N},loop={nloop},model={mdl},"
                             f"block={ci},avg={nadmm})={rec['dual_residual']:e} "
