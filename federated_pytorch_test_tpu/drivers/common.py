@@ -60,6 +60,17 @@ def config_from_args(args: argparse.Namespace) -> FederatedConfig:
     return FederatedConfig(**kw)
 
 
+def enable_compile_cache() -> None:
+    """Driver-entry compile-cache setup: TPU compiles of the per-block
+    epoch dominate cold runs, so every CLI enables the shared persistent
+    cache first thing (VAE/CPC mains call this too)."""
+    from federated_pytorch_test_tpu.utils.compile_cache import (
+        enable_persistent_compile_cache,
+    )
+
+    enable_persistent_compile_cache()
+
+
 def apply_platform(cfg: FederatedConfig) -> None:
     """Honor ``use_tpu`` (the reference's ``use_cuda`` gate,
     federated_multi.py:32): when False, run on the host CPU platform.
@@ -134,6 +145,7 @@ def run_classifier_driver(prog: str, defaults: FederatedConfig,
                           argv=None):
     args = build_parser(defaults, prog).parse_args(argv)
     cfg = config_from_args(args)
+    enable_compile_cache()
     apply_platform(cfg)
     trainer = make_trainer(cfg, algorithm, args.n_train, args.n_test)
     print(f"{prog}: K={cfg.K} model={'ResNet18' if cfg.use_resnet else 'Net'} "

@@ -42,9 +42,18 @@ def main(argv=None):
                    default=False)
     p.add_argument("--save-model", action=argparse.BooleanOptionalAction,
                    default=True)
+    p.add_argument("--use-tpu", action=argparse.BooleanOptionalAction,
+                   default=True)
     p.add_argument("--checkpoint-dir", default="./checkpoints")
     args = p.parse_args(argv)
 
+    from federated_pytorch_test_tpu.drivers.common import (
+        apply_platform,
+        enable_compile_cache,
+    )
+
+    enable_compile_cache()
+    apply_platform(args)                 # duck-typed: needs .use_tpu only
     data = CPCDataSource(args.file_list, args.sap_list,
                          batch_size=args.batch_size,
                          patch_size=args.patch_size, seed=args.seed)

@@ -18,12 +18,11 @@ DEFAULTS = FederatedConfig(K=10, Nloop=12, Nepoch=1, Nadmm=3,
 def main(argv=None):
     args = common.build_parser(DEFAULTS, "federated_vae").parse_args(argv)
     cfg = common.config_from_args(args)
-    # include_remainder=False: the VAE's sum-reduction loss has no
-    # per-sample weighting hook (see VAETrainer.model_loss); PARITY.md C18
+    common.enable_compile_cache()
+    common.apply_platform(cfg)
     data = FederatedCifar10(
         K=cfg.K, batch=cfg.default_batch, biased_input=cfg.biased_input,
-        drop_last_sample=cfg.drop_last_sample, include_remainder=False,
-        data_dir=cfg.data_dir,
+        drop_last_sample=cfg.drop_last_sample, data_dir=cfg.data_dir,
         limit_per_client=args.n_train, limit_test=args.n_test)
     trainer = VAETrainer(AutoEncoderCNN(), cfg, data, FedAvg())
     print(f"federated_vae: K={cfg.K} devices={trainer.D} data={data.source}")

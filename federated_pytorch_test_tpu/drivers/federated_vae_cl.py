@@ -28,11 +28,11 @@ def main(argv=None):
                    help="latent dimension (federated_vae_cl.py:23)")
     args = p.parse_args(argv)
     cfg = common.config_from_args(args)
-    # include_remainder=False — see drivers/federated_vae.py
+    common.enable_compile_cache()
+    common.apply_platform(cfg)
     data = FederatedCifar10(
         K=cfg.K, batch=cfg.default_batch, biased_input=cfg.biased_input,
-        drop_last_sample=cfg.drop_last_sample, include_remainder=False,
-        data_dir=cfg.data_dir,
+        drop_last_sample=cfg.drop_last_sample, data_dir=cfg.data_dir,
         limit_per_client=args.n_train, limit_test=args.n_test)
     model = AutoEncoderCNNCL(K=args.Kc, L=args.Lc)
     trainer = VAECLTrainer(model, cfg, data, FedAvg())

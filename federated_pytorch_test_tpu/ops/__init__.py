@@ -1,0 +1,16 @@
+"""TPU kernel ops (Pallas).
+
+Hand-written Pallas kernels for the framework's hot ops, with XLA fallbacks
+so every op runs identically on CPU/interpret mode.  Currently:
+
+  * :func:`info_nce_fused` — fused InfoNCE (CPC contrastive loss): Gram
+    matmul + normalisation + online log-softmax + diagonal gather in one
+    VMEM-resident kernel.
+"""
+
+from federated_pytorch_test_tpu.ops.infonce import (  # noqa: F401
+    force_infonce_impl,
+    info_nce_fused,
+)
+
+__all__ = ["info_nce_fused", "force_infonce_impl"]

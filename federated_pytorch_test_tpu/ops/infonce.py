@@ -1,0 +1,195 @@
+"""Fused InfoNCE (CPC contrastive loss) as a Pallas TPU kernel.
+
+The reference computes the (P x P) normalised inner-product matrix with
+nested Python loops (federated_cpc.py:149-180); the framework's XLA path
+(train/cpc_losses.py) is one matmul + log-softmax.  This module fuses the
+whole per-row pipeline into ONE kernel so the score matrix never leaves
+VMEM:
+
+    scores_tile = (Z_tile^T @ Zhat) / (||Z_tile|| ||Zhat||)   (MXU)
+    log_p_row   = diag(scores) - logsumexp_row(scores)        (VPU)
+
+i.e. column norms, the Gram matmul, the numerically-stable row softmax
+and the positive-pair (diagonal) gather all happen in one VMEM residency
+— the [P, P] matrix is never materialised in HBM.  The grid tiles rows of
+the score matrix (T=128 = MXU edge); each program reads its [D, T] column
+slab of Z plus the full [D, P] Zhat.
+
+Gradients: the op carries a ``jax.custom_vjp`` with a hand-derived
+backward built from the saved ``log_p`` residual (one matmul to rebuild
+the score matrix — unavoidable, the softmax Jacobian needs it — but no
+forward re-run and no logsumexp recompute), so the kernel drops into the
+CPC training closure (LBFGS re-evaluates value_and_grad inside
+``lax.while_loop``) with no tracing restrictions and no extra forward.
+
+Dispatch: the Pallas path runs when the default backend is TPU and the
+working set fits the VMEM budget; otherwise the XLA path runs (identical
+result).  Tests exercise the kernel on CPU via ``interpret=True``
+(:func:`force_infonce_impl`).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from federated_pytorch_test_tpu.train.cpc_losses import (
+    flat_patch_matrix,
+    log_p_flat,
+    safe_norms,
+)
+
+_TILE = 128                 # row tile = MXU edge
+_SUBLANE = 8                # float32 sublane multiple
+_VMEM_BUDGET = 12 * 2**20   # leave headroom under the ~16 MB/core VMEM
+
+# None = auto (TPU -> pallas, else XLA); "xla" | "pallas" | "pallas_interpret"
+_FORCE_IMPL = None
+
+
+@contextlib.contextmanager
+def force_infonce_impl(impl: str):
+    """Force the InfoNCE implementation ("xla" | "pallas" |
+    "pallas_interpret") — tests run the kernel on CPU via interpret mode."""
+    global _FORCE_IMPL
+    prev, _FORCE_IMPL = _FORCE_IMPL, impl
+    try:
+        yield
+    finally:
+        _FORCE_IMPL = prev
+
+
+def _loss_from_log_p(log_p: jnp.ndarray) -> jnp.ndarray:
+    """-sum log(softmax_diag + 1e-6) — the reference adds 1e-6 inside the
+    log (federated_cpc.py:178)."""
+    return -jnp.sum(jnp.log(jnp.exp(log_p) + 1e-6))
+
+
+def _log_p_kernel(P: int, z_ref, zhat_ref, out_ref):
+    """One [T, P_pad] row-tile of the score matrix, reduced to log_p [T].
+
+    ``P`` (static) is the true column count; pad columns are masked to
+    -inf before the row logsumexp.  Pad columns have zero norm, so the
+    divisor is made pad-safe (the masked scores never contribute).
+    """
+    i = pl.program_id(0)
+    a = z_ref[:, :]          # [D_pad, T]   this tile's columns of Z
+    zh = zhat_ref[:, :]      # [D_pad, P_pad]
+    zn = jnp.sqrt(jnp.sum(a * a, axis=0))       # [T]
+    zhn = jnp.sqrt(jnp.sum(zh * zh, axis=0))    # [P_pad]
+    zn = jnp.where(zn == 0.0, 1.0, zn)
+    zhn = jnp.where(zhn == 0.0, 1.0, zhn)
+    # contract over D without an explicit transpose: [T, P_pad] on the MXU
+    zz = jax.lax.dot_general(
+        a, zh, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) / (zn[:, None] * zhn[None, :])
+
+    t = zz.shape[0]
+    col = jax.lax.broadcasted_iota(jnp.int32, (t, zz.shape[1]), 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (t, zz.shape[1]), 0) + i * t
+    valid = col < P
+    zzm = jnp.where(valid, zz, -jnp.inf)
+    m = jnp.max(zzm, axis=1, keepdims=True)            # [T, 1]
+    lse = m[:, 0] + jnp.log(jnp.sum(jnp.exp(zzm - m), axis=1))
+    diag = jnp.sum(jnp.where(col == row, zz, 0.0), axis=1)
+    out_ref[0, :] = diag - lse
+
+
+def _pallas_fits(D_pad: int, P_pad: int) -> bool:
+    per_program = 4 * (D_pad * (_TILE + P_pad) + _TILE * P_pad)
+    return per_program <= _VMEM_BUDGET
+
+
+def _log_p_pallas(Z: jnp.ndarray, Zhat: jnp.ndarray,
+                  interpret: bool = False) -> jnp.ndarray:
+    D, P = Z.shape
+    P_pad = pl.cdiv(P, _TILE) * _TILE
+    D_pad = pl.cdiv(D, _SUBLANE) * _SUBLANE
+    Zp = jnp.pad(Z, ((0, D_pad - D), (0, P_pad - P)))
+    Zhp = jnp.pad(Zhat, ((0, D_pad - D), (0, P_pad - P)))
+    out = pl.pallas_call(
+        functools.partial(_log_p_kernel, P),
+        grid=(P_pad // _TILE,),
+        in_specs=[
+            pl.BlockSpec((D_pad, _TILE), lambda i: (0, i)),
+            pl.BlockSpec((D_pad, P_pad), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, _TILE), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, P_pad), jnp.float32),
+        interpret=interpret,
+    )(Zp, Zhp)
+    return out[0, :P]
+
+
+def _dispatch_log_p(Z: jnp.ndarray, Zhat: jnp.ndarray) -> jnp.ndarray:
+    impl = _FORCE_IMPL
+    if impl is None:
+        D, P = Z.shape
+        fits = _pallas_fits(pl.cdiv(D, _SUBLANE) * _SUBLANE,
+                            pl.cdiv(P, _TILE) * _TILE)
+        impl = "pallas" if (jax.default_backend() == "tpu" and fits) else "xla"
+    if impl == "xla":
+        return log_p_flat(Z, Zhat)          # shared core, train/cpc_losses.py
+    return _log_p_pallas(Z, Zhat, interpret=impl == "pallas_interpret")
+
+
+@jax.custom_vjp
+def _fused_flat(Z: jnp.ndarray, Zhat: jnp.ndarray) -> jnp.ndarray:
+    return _loss_from_log_p(_dispatch_log_p(Z, Zhat))
+
+
+def _fused_flat_fwd(Z, Zhat):
+    log_p = _dispatch_log_p(Z, Zhat)
+    return _loss_from_log_p(log_p), (Z, Zhat, log_p)
+
+
+def _fused_flat_bwd(res, ct):
+    """Hand-derived VJP from the saved ``log_p`` residual.
+
+    The LBFGS closure evaluates value_and_grad on every (re-)evaluation,
+    so the backward matters: rebuilding the score matrix costs one matmul
+    (unavoidable — the softmax Jacobian needs it), but the saved log_p
+    recovers the row logsumexp as ``diag(zz) - log_p``, so no reduction
+    or forward pass is re-run.  With L = -sum_i log(exp(g_i) + 1e-6),
+    g_i = zz_ii - lse_i and zz = (Z^T Zhat) / (zn zhn^T):
+
+        dL/dzz_ij = ghat_i (delta_ij - softmax_i(zz)_ij),
+        ghat_i    = -ct * exp(g_i) / (exp(g_i) + 1e-6)
+
+    then the quotient rule routes dL/dzz into Z, Zhat both through the
+    Gram numerator and the column norms.
+    """
+    Z, Zhat, log_p = res
+    # same zero-norm guard as every forward path (cpc_losses.safe_norms):
+    # a guarded column has zz ≡ 0, so the norm-path terms (dzn/dzhn)
+    # vanish and only the finite numerator path contributes — no NaNs
+    zn = safe_norms(Z)
+    zhn = safe_norms(Zhat)
+    denom = zn[:, None] * zhn[None, :]
+    zz = (Z.T @ Zhat) / denom
+    lse = jnp.diag(zz) - log_p
+    s = jnp.exp(zz - lse[:, None])                    # softmax rows
+    c = jnp.exp(log_p)
+    ghat = -ct * c / (c + 1e-6)                       # [P]
+    G = ghat[:, None] * (jnp.eye(zz.shape[0], dtype=zz.dtype) - s)
+    Gn = G / denom
+    dzn = -jnp.sum(G * zz, axis=1) / zn
+    dzhn = -jnp.sum(G * zz, axis=0) / zhn
+    dZ = Zhat @ Gn.T + Z * (dzn / zn)[None, :]
+    dZhat = Z @ Gn + Zhat * (dzhn / zhn)[None, :]
+    return dZ, dZhat
+
+
+_fused_flat.defvjp(_fused_flat_fwd, _fused_flat_bwd)
+
+
+def info_nce_fused(z: jnp.ndarray, zhat: jnp.ndarray) -> jnp.ndarray:
+    """InfoNCE over patch positions, same contract as
+    :func:`train.cpc_losses.info_nce` (z, zhat: [B, px, py, R] NHWC;
+    reference federated_cpc.py:149-180) — Pallas-fused on TPU."""
+    return _fused_flat(flat_patch_matrix(z), flat_patch_matrix(zhat))

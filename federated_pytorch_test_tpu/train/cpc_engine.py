@@ -37,7 +37,7 @@ from federated_pytorch_test_tpu.parallel.mesh import (
     client_sharding,
     usable_device_count,
 )
-from federated_pytorch_test_tpu.train.cpc_losses import info_nce
+from federated_pytorch_test_tpu.ops.infonce import info_nce_fused
 from federated_pytorch_test_tpu.utils import blocks as blocklib
 from federated_pytorch_test_tpu.utils import codec
 from federated_pytorch_test_tpu.utils.initializers import init_weights
@@ -109,7 +109,9 @@ class CPCTrainer:
         context = self.models["contextgen"].apply({"params": ctx_p}, grid)
         reduced, pred = self.models["predictor"].apply(
             {"params": pred_p}, grid, context)
-        return info_nce(reduced, pred)
+        # Pallas-fused on TPU (ops/infonce.py); XLA path elsewhere —
+        # identical math either way (tests assert equality)
+        return info_nce_fused(reduced, pred)
 
     def _build_round(self, mdl: str, ci: int, px: int, py: int):
         """Jitted (train Niter batches + fedavg + writeback) for one

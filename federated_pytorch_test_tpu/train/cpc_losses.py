@@ -11,20 +11,40 @@ import jax.numpy as jnp
 from jax.scipy.special import logsumexp
 
 
+def flat_patch_matrix(z: jnp.ndarray) -> jnp.ndarray:
+    """[B, px, py, R] NHWC -> [B*R, P]: column p stacks (batch x channel)
+    values of patch position p (the reference's zz-matrix layout,
+    federated_cpc.py:149-180)."""
+    B, px, py, R = z.shape
+    return z.transpose(0, 3, 1, 2).reshape(-1, px * py)
+
+
+def safe_norms(Z: jnp.ndarray) -> jnp.ndarray:
+    """Column L2 norms with zero columns mapped to 1.
+
+    The reference divides by the raw norm, so an all-zero patch column
+    (e.g. dead features early in training) yields 0/0 = NaN there
+    (federated_cpc.py:160-166); guarding keeps every dispatch path of the
+    fused op (ops/infonce.py) finite and mutually identical.
+    """
+    n = jnp.linalg.norm(Z, axis=0)
+    return jnp.where(n == 0.0, 1.0, n)
+
+
+def log_p_flat(Z: jnp.ndarray, Zhat: jnp.ndarray) -> jnp.ndarray:
+    """Per-position log softmax-diagonal [P] from flat [D, P] matrices —
+    the single XLA reference core shared by :func:`info_nce` and the
+    Pallas op's fallback/backward (ops/infonce.py)."""
+    zz = (Z.T @ Zhat) / (safe_norms(Z)[:, None] * safe_norms(Zhat)[None, :])
+    return jnp.diag(zz) - logsumexp(zz, axis=1)
+
+
 def info_nce(z: jnp.ndarray, zhat: jnp.ndarray) -> jnp.ndarray:
     """z, zhat: [B, px, py, R] (NHWC; the reference is [B, C, px, py]).
 
-    Columns are patch positions: Z[:, p] stacks (batch x channel) values of
-    position p.  zz[i, j] = <Z[:,i], Zhat[:,j]> / (||Z[:,i]|| ||Zhat[:,j]||);
+    zz[i, j] = <Z[:,i], Zhat[:,j]> / (||Z[:,i]|| ||Zhat[:,j]||);
     positives on the diagonal; loss = -sum_i log(softmax_row_i[i] + 1e-6)
     (the reference adds 1e-6 inside the log, federated_cpc.py:178).
     """
-    B, px, py, R = z.shape
-    P = px * py
-    Z = z.transpose(0, 3, 1, 2).reshape(-1, P)
-    Zhat = zhat.transpose(0, 3, 1, 2).reshape(-1, P)
-    zn = jnp.linalg.norm(Z, axis=0)          # [P]
-    zhn = jnp.linalg.norm(Zhat, axis=0)      # [P]
-    zz = (Z.T @ Zhat) / (zn[:, None] * zhn[None, :])
-    log_p = jnp.diag(zz) - logsumexp(zz, axis=1)
+    log_p = log_p_flat(flat_patch_matrix(z), flat_patch_matrix(zhat))
     return -jnp.sum(jnp.log(jnp.exp(log_p) + 1e-6))

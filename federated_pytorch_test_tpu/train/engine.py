@@ -201,8 +201,8 @@ class BlockwiseFederatedTrainer:
         ``wb`` [B] marks pad rows of the final partial minibatch with 0
         (drop_last=False parity); the weighted mean equals the reference's
         mean over the true partial batch.  Subclasses override for
-        VAE/VAE-CL losses (their CIFAR pipelines run full batches only —
-        see drivers/federated_vae.py — so they ignore ``wb``).
+        VAE/VAE-CL losses and must thread ``wb`` into their weighted loss
+        the same way (train/vae_losses.py).
         """
         logits, new_bs = self._apply_train(p, bs, xb)
         return self.loss_fn(logits, yb, wb), new_bs

@@ -39,17 +39,17 @@ class VAETrainer(BlockwiseFederatedTrainer):
         return (0.0, 0.0)
 
     def model_loss(self, p, bs, xb, yb, wb, rng):
-        # wb unused: the VAE drivers construct FederatedCifar10 with
-        # include_remainder=False (sum-reduction losses have no per-sample
-        # decomposition in the reference either, federated_vae.py:96-108)
+        # wb weights out the pad rows of the wrap-padded final partial
+        # minibatch (drop_last=False parity, federated_multi.py:74-83):
+        # the sum-reduction ELBO decomposes per sample
         recon, mu, logvar = self.model.apply({"params": p}, xb, rng)
-        return vae_loss(recon, xb, mu, logvar), bs
+        return vae_loss(recon, xb, mu, logvar, wb), bs
 
     def eval_batch_metric(self, p, bs, xb, yb, wb):
         # fixed key: deterministic eval ELBO
         recon, mu, logvar = self.model.apply(
             {"params": p}, xb, jax.random.PRNGKey(0))
-        return vae_loss(recon, xb, mu, logvar)
+        return vae_loss(recon, xb, mu, logvar, wb)
 
     def eval_finalize(self, totals: np.ndarray, n_samples: int) -> np.ndarray:
         return totals / n_samples   # mean test ELBO per sample
@@ -85,18 +85,22 @@ class VAECLTrainer(BlockwiseFederatedTrainer):
         return (0.0, self.cfg.lambda2)   # unconditional L2 (:228-230)
 
     def model_loss(self, p, bs, xb, yb, wb, rng):
-        # wb unused — see VAETrainer.model_loss
+        # wb weights out pad rows; every mean-over-batch divisor in the
+        # clustering ELBO becomes sum(wb) = the true partial-batch size
         out = self.model.apply({"params": p}, xb, rng, reparam=True)
         ekhat, mu_xi, sig2_xi, mu_b, sig2_b, mu_th, sig2_th = out
         return vae_cl_loss(ekhat, mu_xi, sig2_xi, mu_b, sig2_b,
-                           mu_th, sig2_th, xb), bs
+                           mu_th, sig2_th, xb, w=wb), bs
 
     def eval_batch_metric(self, p, bs, xb, yb, wb):
         out = self.model.apply({"params": p}, xb, jax.random.PRNGKey(0),
                                reparam=True)
         ekhat, mu_xi, sig2_xi, mu_b, sig2_b, mu_th, sig2_th = out
+        # vae_cl_loss is a per-batch MEAN (divisors are sum(wb)); the eval
+        # accumulator sums across batches and eval_finalize divides by the
+        # total sample count, so scale back to a per-batch sum here
         return vae_cl_loss(ekhat, mu_xi, sig2_xi, mu_b, sig2_b,
-                           mu_th, sig2_th, xb)
+                           mu_th, sig2_th, xb, w=wb) * jnp.sum(wb)
 
     def eval_finalize(self, totals: np.ndarray, n_samples: int) -> np.ndarray:
         return totals / n_samples        # mean test ELBO per sample

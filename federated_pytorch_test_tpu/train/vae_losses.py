@@ -7,6 +7,12 @@ Vectorised re-designs of the reference loss functions:
     (federated_vae_cl.py:101-162).  The reference computes each term with a
     Python loop over the batch (cost1/cost2/cost3, federated_vae_cl.py:101-140);
     here each is one weighted reduction — same math, one XLA kernel.
+
+All functions take an optional per-sample weight vector ``w`` [B] so the
+wrap-padded final partial minibatch (torch DataLoader drop_last=False,
+federated_multi.py:74-83) contributes exactly the reference's value: pad
+rows carry weight 0, and every mean-over-batch divisor becomes ``sum(w)``
+— the true sample count of the partial batch.  ``w=None`` means all-ones.
 """
 
 from __future__ import annotations
@@ -18,54 +24,72 @@ import jax.numpy as jnp
 _TWO_PI = 2.0 * math.pi
 
 
-def vae_loss(recon_x, x, mu, logvar):
+def _ones_like_batch(pk, w):
+    return jnp.ones(pk.shape[0], pk.dtype) if w is None else w
+
+
+def vae_loss(recon_x, x, mu, logvar, w=None):
     """sum-MSE + KLD, KLD = -0.5 sum(1 + logvar - mu^2 - sigma^2)
-    (federated_vae.py:96-108; reduction='sum' on both terms)."""
-    mse = jnp.sum((recon_x - x) ** 2)
-    kld = -0.5 * jnp.sum(1.0 + logvar - mu ** 2 - jnp.exp(logvar))
-    return mse + kld
+    (federated_vae.py:96-108; reduction='sum' on both terms).
+
+    Both reductions are per-sample sums, so weighting each sample's
+    contribution by ``w`` reproduces the reference's sum over the true
+    (possibly partial) batch exactly.
+    """
+    b = x.shape[0]
+    mse = jnp.sum((recon_x - x) ** 2, axis=tuple(range(1, x.ndim))) \
+        if x.ndim > 1 else (recon_x - x) ** 2
+    kld = -0.5 * jnp.sum(
+        (1.0 + logvar - mu ** 2 - jnp.exp(logvar)).reshape(b, -1), axis=1)
+    if w is None:
+        return jnp.sum(mse) + jnp.sum(kld)
+    return jnp.sum(w * mse) + jnp.sum(w * kld)
 
 
 # ---------------------------------------------------------------------------
 # clustering VAE (federated_vae_cl.py)
 # ---------------------------------------------------------------------------
 
-def cost1(pk, mu_th, sig2_th, x):
+def cost1(pk, mu_th, sig2_th, x, w=None):
     """Weighted reconstruction -E_qk[log p(x|theta)] (federated_vae_cl.py:101-109).
 
     pk: [B] cluster responsibilities; mu_th/sig2_th: [B, ...] likelihood
     params; x: [B, ...].  Mean over the batch of pk_i * sum_i(err + err1).
     """
     b = x.shape[0]
+    w = _ones_like_batch(pk, w)
     err = (x - mu_th) ** 2 / (2.0 * sig2_th)
     err1 = 0.5 * jnp.log(sig2_th * _TWO_PI)
     per_sample = jnp.sum((err + err1).reshape(b, -1), axis=1)
-    return jnp.sum(pk * per_sample) / b
+    return jnp.sum(w * pk * per_sample) / jnp.sum(w)
 
 
-def cost2(pk):
+def cost2(pk, w=None):
     """Sample-wise entropy -E[log q(k|x)] (federated_vae_cl.py:113-118)."""
-    return jnp.sum(-pk * jnp.log(pk + 1e-9)) / pk.shape[0]
+    w = _ones_like_batch(pk, w)
+    return jnp.sum(-w * pk * jnp.log(pk + 1e-9)) / jnp.sum(w)
 
 
-def cost21(pk):
+def cost21(pk, w=None):
     """Inverse batch-entropy (anti-cluster-collapse, federated_vae_cl.py:122-126)."""
-    pbar = jnp.mean(pk)
+    w = _ones_like_batch(pk, w)
+    pbar = jnp.sum(w * pk) / jnp.sum(w)
     return 1.0 / (-pbar * jnp.log(pbar + 1e-9) + 1e-9)
 
 
-def cost3(pk, q_z_mu, q_z_sig2, p_z_mu, p_z_sig2):
+def cost3(pk, q_z_mu, q_z_sig2, p_z_mu, p_z_sig2, w=None):
     """KL(q(z|x,k) || p(z|k)) weighted by pk (federated_vae_cl.py:131-140)."""
     b = pk.shape[0]
+    w = _ones_like_batch(pk, w)
     mudiff = (p_z_mu - q_z_mu) ** 2 / p_z_sig2
     sigratio = q_z_sig2 / p_z_sig2
     per_sample = 0.5 * jnp.sum(
         (sigratio - jnp.log(sigratio) + mudiff - 1.0).reshape(b, -1), axis=1)
-    return jnp.sum(pk * per_sample) / b
+    return jnp.sum(w * pk * per_sample) / jnp.sum(w)
 
 
 def vae_cl_loss(ekhat, mu_xi, sig2_xi, mu_b, sig2_b, mu_th, sig2_th, x,
-                alpha: float = 10.0, beta: float = 1.0):
+                alpha: float = 10.0, beta: float = 1.0, w=None):
     """Total clustering ELBO (federated_vae_cl.py:142-162).
 
     ekhat: [B, K]; the per-cluster tensors carry a leading K axis [K, B, ...]
@@ -76,10 +100,11 @@ def vae_cl_loss(ekhat, mu_xi, sig2_xi, mu_b, sig2_b, mu_th, sig2_th, x,
 
     def per_cluster(pk, mu_xi_k, sig2_xi_k, mu_b_k, sig2_b_k, mu_th_k,
                     sig2_th_k):
-        return (cost1(pk, mu_th_k, sig2_th_k, x)
-                + alpha * (cost2(pk)
-                           + cost3(pk, mu_xi_k, sig2_xi_k, mu_b_k, sig2_b_k))
-                + beta * cost21(pk))
+        return (cost1(pk, mu_th_k, sig2_th_k, x, w)
+                + alpha * (cost2(pk, w)
+                           + cost3(pk, mu_xi_k, sig2_xi_k, mu_b_k, sig2_b_k,
+                                   w))
+                + beta * cost21(pk, w))
 
     per_k = jax.vmap(per_cluster)(
         ekhat.T, mu_xi, sig2_xi, mu_b, sig2_b, mu_th, sig2_th)

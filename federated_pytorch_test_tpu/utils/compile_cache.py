@@ -20,11 +20,24 @@ def enable_persistent_compile_cache(cache_dir: Optional[str] = None) -> str:
 
     Safe to call at any time (before or after backend init); failures are
     swallowed because a missing cache only costs compile time.
+
+    Default location: the repo-checkout ``tests/.jax_cache`` (shared with
+    the test suite / graft entry / bench so warm entries carry across) —
+    but only when that tree is writable; an installed (site-packages,
+    possibly read-only) copy of the package falls back to a per-user
+    cache dir instead of writing inside the installation.
     """
     if cache_dir is None:
         root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
         cache_dir = os.path.join(root, "tests", ".jax_cache")
+        if not os.access(os.path.join(root, "tests")
+                         if os.path.isdir(os.path.join(root, "tests"))
+                         else root, os.W_OK):
+            cache_dir = os.path.join(
+                os.environ.get("XDG_CACHE_HOME",
+                               os.path.expanduser("~/.cache")),
+                "federated-pytorch-test-tpu", "jax_cache")
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

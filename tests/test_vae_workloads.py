@@ -125,12 +125,60 @@ class TestClusteringCosts:
         np.testing.assert_allclose(total, naive, rtol=1e-4)
 
 
+class TestWeightedPartialBatch:
+    """Weighted losses on a wrap-padded batch equal the plain losses on the
+    true partial batch (drop_last=False parity, federated_multi.py:74-83)."""
+
+    def test_vae_loss_weighted(self):
+        rng = np.random.default_rng(3)
+        B, real = 8, 5                   # 3 pad rows wrap-copy rows 0..2
+        x = rng.normal(size=(B, 4, 4, 3)).astype(np.float32)
+        r = rng.normal(size=(B, 4, 4, 3)).astype(np.float32)
+        mu = rng.normal(size=(B, 6)).astype(np.float32)
+        logvar = rng.normal(size=(B, 6)).astype(np.float32)
+        w = np.zeros(B, np.float32)
+        w[:real] = 1.0
+        padded = float(vae_loss(jnp.asarray(r), jnp.asarray(x),
+                                jnp.asarray(mu), jnp.asarray(logvar),
+                                jnp.asarray(w)))
+        true = float(vae_loss(jnp.asarray(r[:real]), jnp.asarray(x[:real]),
+                              jnp.asarray(mu[:real]),
+                              jnp.asarray(logvar[:real])))
+        np.testing.assert_allclose(padded, true, rtol=1e-5)
+
+    def test_vae_cl_loss_weighted(self):
+        Kc, B, real = 3, 8, 5
+        rng = np.random.default_rng(4)
+        ekhat = rng.dirichlet(np.ones(Kc), B).astype(np.float32)
+        shape_z = (Kc, B, 5)
+        shape_x = (Kc, B, 4, 4, 3)
+        args = [rng.normal(size=shape_z).astype(np.float32),
+                rng.uniform(0.5, 2, shape_z).astype(np.float32),
+                rng.normal(size=shape_z).astype(np.float32),
+                rng.uniform(0.5, 2, shape_z).astype(np.float32),
+                rng.normal(size=shape_x).astype(np.float32),
+                rng.uniform(0.5, 2, shape_x).astype(np.float32)]
+        x = rng.normal(size=(B, 4, 4, 3)).astype(np.float32)
+        w = np.zeros(B, np.float32)
+        w[:real] = 1.0
+        padded = float(vae_cl_loss(
+            jnp.asarray(ekhat), *(jnp.asarray(a) for a in args),
+            jnp.asarray(x), w=jnp.asarray(w)))
+        true = float(vae_cl_loss(
+            jnp.asarray(ekhat[:real]),
+            *(jnp.asarray(a[:, :real]) for a in args),
+            jnp.asarray(x[:real])))
+        np.testing.assert_allclose(padded, true, rtol=1e-5)
+
+
 class TestVaeDrivers:
     def test_vae_driver_smoke(self, tmp_path, monkeypatch):
         monkeypatch.chdir(tmp_path)
         from federated_pytorch_test_tpu.drivers.federated_vae import main
+        # n-train 40 / batch 16 -> 2 full batches + a wrap-padded remainder
+        # of 8, exercising the weighted partial-minibatch path end-to-end
         state, hist = main(["--K", "2", "--Nloop", "1", "--Nadmm", "1",
-                            "--n-train", "32", "--n-test", "32",
+                            "--n-train", "40", "--n-test", "32",
                             "--default-batch", "16", "--no-save-model"])
         assert len(hist) == 12          # 12 layer sweeps x 1 round
         assert all(np.isfinite(h["loss"]) for h in hist)
@@ -138,8 +186,10 @@ class TestVaeDrivers:
     def test_vae_cl_driver_smoke(self, tmp_path, monkeypatch):
         monkeypatch.chdir(tmp_path)
         from federated_pytorch_test_tpu.drivers.federated_vae_cl import main
+        # remainder batch included (40 = 2x16 + 8) — covers the LBFGS
+        # blocks' weighted-closure path too
         state, hist = main(["--K", "2", "--Nloop", "1", "--Nadmm", "1",
-                            "--n-train", "32", "--n-test", "32",
+                            "--n-train", "40", "--n-test", "32",
                             "--default-batch", "16", "--Kc", "3", "--Lc", "4",
                             "--no-save-model"])
         assert len(hist) == 3           # enc / dec / latent blocks
