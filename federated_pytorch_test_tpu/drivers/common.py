@@ -76,7 +76,14 @@ def apply_platform(cfg: FederatedConfig) -> None:
     federated_multi.py:32): when False, run on the host CPU platform.
     Must be called before the first JAX device query; if the backend is
     already initialized on a non-CPU platform, warns instead of failing.
+
+    Also joins the multi-host runtime first when ``FEDTPU_DISTRIBUTED=1``
+    (parallel/mesh.py:initialize_multihost) — every driver routes through
+    here before its first device query.
     """
+    from federated_pytorch_test_tpu.parallel.mesh import initialize_multihost
+
+    initialize_multihost()
     if cfg.use_tpu:
         return
     import jax
@@ -129,13 +136,14 @@ def maybe_load(trainer: BlockwiseFederatedTrainer, name: str):
     path = checkpoint_path(cfg, name)
     if cfg.load_model and os.path.isdir(os.path.abspath(os.path.expanduser(path))):
         restored, _ = load_checkpoint(path, like=None)
-        from federated_pytorch_test_tpu.parallel.mesh import client_sharding
-        import jax
+        from federated_pytorch_test_tpu.parallel.mesh import (
+            client_sharding,
+            stage_tree_global,
+        )
         csh = client_sharding(trainer.mesh)
-        params = jax.tree.map(lambda x: jax.device_put(x, csh), restored["params"])
-        bstats = jax.tree.map(lambda x: jax.device_put(x, csh),
-                              restored["batch_stats"])
-        state = state._replace(params=params, batch_stats=bstats)
+        state = state._replace(
+            params=stage_tree_global(restored["params"], csh),
+            batch_stats=stage_tree_global(restored["batch_stats"], csh))
         print(f"loaded checkpoint <- {path}")
     return state
 

@@ -65,3 +65,69 @@ def shard_clients(tree, mesh: Mesh):
     """device_put every leaf with its leading axis sharded over 'clients'."""
     sh = client_sharding(mesh)
     return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+
+
+# ---------------------------------------------------------------------------
+# multi-host (DCN) support — SURVEY.md section 5 comm plan: the same
+# collectives lower to ICI within a slice and DCN across slices; what
+# multi-host additionally needs is (a) one jax.distributed runtime, (b)
+# host->device staging that only materialises each process's addressable
+# shards, and (c) host fetches that all-gather across processes.
+# ---------------------------------------------------------------------------
+
+def initialize_multihost() -> bool:
+    """Join the multi-host JAX runtime when requested.
+
+    Opt-in via ``FEDTPU_DISTRIBUTED=1`` (TPU pods auto-discover the
+    coordinator; other platforms use the standard ``jax.distributed``
+    env vars).  Call BEFORE any device query.  Returns True when running
+    multi-process afterwards.  A no-op (False) when unset, so single-host
+    behavior — every test, bench, and dry run — is unchanged.
+    """
+    import os
+
+    if os.environ.get("FEDTPU_DISTRIBUTED") != "1":
+        # do NOT touch jax here: process_count() would initialize the
+        # backend and defeat a later platform override (--no-use-tpu)
+        return False
+    if not jax.distributed.is_initialized():
+        # genuine init failures (unreachable coordinator, ...) must raise:
+        # a worker silently proceeding single-process while its peers
+        # joined the global mesh hangs at the first collective instead
+        jax.distributed.initialize()
+    return jax.process_count() > 1
+
+
+def stage_global(x, sharding: NamedSharding):
+    """Host array -> global device array under ``sharding``.
+
+    Single-process: a plain ``device_put``.  Multi-process: every process
+    holds the SAME full array (the data pipelines are seed-deterministic,
+    data/cifar10.py), and ``jax.make_array_from_callback`` materialises
+    only this process's addressable shards — each host feeds its own
+    slice of the client axis, nothing is sent over DCN at staging time.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    return jax.make_array_from_callback(x.shape, sharding,
+                                        lambda idx: x[idx])
+
+
+def stage_tree_global(tree, sharding: NamedSharding):
+    """``stage_global`` over every leaf (host/numpy-coerced first) — the
+    shared checkpoint-restore staging path (engine restore, driver load)."""
+    return jax.tree.map(
+        lambda x: stage_global(np.asarray(x), sharding), tree)
+
+
+def fetch(x):
+    """Device array -> host numpy, valid on every process.
+
+    Single-process: ``np.asarray``.  Multi-process: client-sharded arrays
+    have non-addressable shards, so all-gather across processes first.
+    """
+    if jax.process_count() == 1:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))

@@ -35,6 +35,8 @@ from federated_pytorch_test_tpu.parallel.mesh import (
     CLIENT_AXIS,
     client_mesh,
     client_sharding,
+    fetch,
+    stage_global,
     usable_device_count,
 )
 from federated_pytorch_test_tpu.ops.infonce import info_nce_fused
@@ -214,11 +216,13 @@ class CPCTrainer:
                             z = jnp.zeros((N,), jnp.float32)
                             opt_state = init_fn(state)
                         state, z, opt_state, dual, losses = fn(
-                            state, z, opt_state, jax.device_put(batch, csh))
+                            state, z, opt_state,
+                            jax.tree.map(lambda b: stage_global(b, csh),
+                                         batch))
                         rec = dict(nloop=nloop, model=mdl, block=ci,
                                    nadmm=nadmm, N=N,
                                    dual_residual=float(dual),
-                                   loss=float(np.sum(np.asarray(losses))),
+                                   loss=float(np.sum(fetch(losses))),
                                    round_seconds=(time.perf_counter()
                                                   - t_round))
                         history.append(rec)

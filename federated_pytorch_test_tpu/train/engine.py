@@ -42,7 +42,10 @@ from federated_pytorch_test_tpu.parallel.mesh import (
     CLIENT_AXIS,
     client_mesh,
     client_sharding,
+    fetch,
     replicated_sharding,
+    stage_global,
+    stage_tree_global,
     usable_device_count,
 )
 from federated_pytorch_test_tpu.train.algorithms import (
@@ -149,13 +152,16 @@ class BlockwiseFederatedTrainer:
 
         # test set staged once: uint8 replicated across the mesh, labels and
         # pad weights replicated, per-client normalisation stats sharded
+        # (stage_global = device_put single-process; local-shards-only on
+        # multi-host, parallel/mesh.py)
         rsh = replicated_sharding(mesh)
         xt_u8, yt, wt = data.test_batches_raw()
-        self.test_x = jax.device_put(xt_u8, rsh)     # [tsteps, B, 32,32,3] u8
-        self.test_y = jax.device_put(yt, rsh)        # [tsteps, B] i32
-        self.test_w = jax.device_put(wt, rsh)        # [tsteps, B] f32
-        self.client_norm = jax.device_put(
-            jnp.asarray(data.norm_stats, jnp.float32), csh  # [K, 2, 3]
+        self.test_x = stage_global(xt_u8, rsh)       # [tsteps, B, 32,32,3] u8
+        self.test_y = stage_global(yt, rsh)          # [tsteps, B] i32
+        self.test_w = stage_global(wt, rsh)          # [tsteps, B] f32
+        self.test_n = int(wt.sum())                  # true test sample count
+        self.client_norm = stage_global(
+            np.asarray(data.norm_stats, np.float32), csh  # [K, 2, 3]
         )
 
     # ------------------------------------------------------------------
@@ -449,23 +455,24 @@ class BlockwiseFederatedTrainer:
         fn = self._build_eval()
         totals = fn(state.params, state.batch_stats, self.client_norm,
                     self.test_x, self.test_y, self.test_w)
-        total = int(np.sum(np.asarray(self.test_w)))
-        return self.eval_finalize(np.asarray(totals), total)
+        return self.eval_finalize(fetch(totals), self.test_n)
 
     def _stage_epoch(self):
+        # every process draws the same shuffle (seed-deterministic), so on
+        # multi-host each stages only its addressable client shards
         xb, yb, wb = self.data.epoch_batches_raw(
             int(self._shuffle.integers(2**31)))
         sh = client_sharding(self.mesh)
-        return (jax.device_put(xb, sh), jax.device_put(yb, sh),
-                jax.device_put(wb, sh))
+        return (stage_global(xb, sh), stage_global(yb, sh),
+                stage_global(wb, sh))
 
     def _epoch_keys(self):
         """Per-client PRNG keys [K, 2] for this epoch (reparam sampling —
         replaces torch.cuda.FloatTensor.normal_, simple_models.py:292-301)."""
         base = jax.random.PRNGKey(int(self._shuffle.integers(2**31)))
         keys = jax.random.split(base, self.cfg.K)
-        keys = jnp.asarray(jax.random.key_data(keys))
-        return jax.device_put(keys, client_sharding(self.mesh))
+        keys = np.asarray(jax.random.key_data(keys))
+        return stage_global(keys, client_sharding(self.mesh))
 
     def init_state(self) -> ClientState:
         return ClientState(self.params0, self.batch_stats0, None)
@@ -537,8 +544,8 @@ class BlockwiseFederatedTrainer:
         tree, meta = load_checkpoint(path)
         csh = client_sharding(self.mesh)
         rsh = jax.sharding.NamedSharding(self.mesh, P())
-        put_c = lambda t: jax.tree.map(lambda x: jax.device_put(x, csh), t)
-        put_r = lambda t: jax.tree.map(lambda x: jax.device_put(x, rsh), t)
+        put_c = lambda t: stage_tree_global(t, csh)
+        put_r = lambda t: stage_tree_global(t, rsh)
         mid = bool(meta["mid_block"])
         params = put_c(tree["params"])
         opt = None
@@ -648,14 +655,14 @@ class BlockwiseFederatedTrainer:
                         state, losses = train_epoch(
                             state, y, self.client_norm, self._epoch_keys(),
                             xb, yb, wb, z, rho)
-                        loss_sum += float(np.sum(np.asarray(losses)))
+                        loss_sum += float(np.sum(fetch(losses)))
                         if cfg.be_verbose:
                             # per-client epoch losses (the reference's
                             # be_verbose minibatch prints,
                             # federated_multi.py:199-200)
                             log(f"verbose: block={ci} nadmm={nadmm} "
                                 f"epoch={nepoch} client_loss="
-                                + np.array2string(np.asarray(losses),
+                                + np.array2string(fetch(losses),
                                                   precision=4))
                     if algo.communicates:
                         if cfg.bb_update and nadmm == 0:
@@ -725,7 +732,7 @@ class BlockwiseFederatedTrainer:
             state, losses = train_epoch(state, y, self.client_norm,
                                         self._epoch_keys(), xb, yb, wb, z,
                                         rho)
-            rec = dict(epoch=epoch, loss=float(np.sum(np.asarray(losses))),
+            rec = dict(epoch=epoch, loss=float(np.sum(fetch(losses))),
                        epoch_seconds=time.perf_counter() - t_epoch)
             if cfg.check_results:
                 rec["accuracy"] = self.evaluate(state)
