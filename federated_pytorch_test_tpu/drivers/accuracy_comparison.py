@@ -110,6 +110,64 @@ def run_comparison(K: int = 10, Nloop: int = 3, Nadmm: int = 3,
     return results
 
 
+#: fixed color per entity (never re-assigned by rank/order; the palette is
+#: a validated 4-slot categorical set — adjacent-pair CVD-safe; the
+#: low-contrast yellow slot is relieved by direct end-of-line labels)
+_SERIES = (("upper_k1", "#2a78d6", "K=1 upper bound"),
+           ("fedavg", "#eb6834", "FedAvg K=10"),
+           ("consensus", "#1baf7a", "consensus K=10"),
+           ("standalone", "#eda100", "standalone 1/K"))
+
+
+def write_plot(results: Dict[str, object], path: str) -> None:
+    """The repo's analogue of the reference's comparison.png (README.md:28-30):
+    test-accuracy curves of the four runs over normalized training budget
+    (the runs evaluate at different cadences — standalone per epoch,
+    federated per communication round — so the x axis is fraction of run,
+    one shared scale, not a dual axis)."""
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(7.2, 4.4), dpi=150)
+    fig.patch.set_facecolor("#fcfcfb")
+    ax.set_facecolor("#fcfcfb")
+    ends = []
+    for name, color, label in _SERIES:
+        c = results[name]
+        x = [100.0 * i / max(len(c) - 1, 1) for i in range(len(c))]
+        ax.plot(x, c, color=color, linewidth=2, label=label,
+                solid_capstyle="round")
+        ends.append([label, float(c[-1])])
+    # dodge overlapping end-of-line labels (saturated runs all finish ~100)
+    ends.sort(key=lambda e: e[1])
+    for prev, cur in zip(ends, ends[1:]):
+        cur[1] = max(cur[1], prev[1] + 3.2)
+    for label, y in ends:
+        ax.annotate(label, (100.0, y), xytext=(6, 0),
+                    textcoords="offset points", fontsize=8,
+                    color="#52514e", va="center")
+    ax.set_xlim(0, 118)                      # headroom for end labels
+    ax.set_xlabel("training budget (%)", color="#52514e")
+    ax.set_ylabel("test accuracy (%)", color="#52514e")
+    ax.set_title("CIFAR10 federated comparison "
+                 f"(K={results['config']['K']}, "
+                 f"data={results['data_source']})",
+                 color="#0b0b0b", fontsize=11)
+    ax.grid(True, color="#e4e3df", linewidth=0.6)
+    for s in ("top", "right"):
+        ax.spines[s].set_visible(False)
+    for s in ("left", "bottom"):
+        ax.spines[s].set_color("#c3c2b7")
+    ax.tick_params(colors="#52514e")
+    ax.legend(loc="lower right", fontsize=8, frameon=False,
+              labelcolor="#0b0b0b")
+    fig.tight_layout()
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fig.savefig(path, facecolor=fig.get_facecolor())
+    plt.close(fig)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="accuracy_comparison",
                                 description=__doc__.splitlines()[0])
@@ -125,17 +183,32 @@ def main(argv=None):
     p.add_argument("--prototypes", type=int, default=32,
                    help="synthetic-fallback templates per class")
     p.add_argument("--out", default="artifacts/accuracy_comparison.json")
+    p.add_argument("--plot", nargs="?", const="artifacts/comparison.png",
+                   default=None,
+                   help="also write the accuracy-curve plot (the reference's "
+                        "comparison.png analogue); optional PATH")
+    p.add_argument("--replot", metavar="JSON", default=None,
+                   help="skip training; plot from an existing results JSON")
     args = p.parse_args(argv)
-    res = run_comparison(K=args.K, Nloop=args.Nloop, Nadmm=args.Nadmm,
-                         batch=args.batch, n_train=args.n_train,
-                         n_test=args.n_test, seed=args.seed,
-                         synthetic_noise=args.noise,
-                         synthetic_prototypes=args.prototypes, log=print)
-    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
-    with open(args.out, "w") as f:
-        json.dump(res, f, indent=1)
+    if args.replot:
+        if args.plot is None:        # --replot's whole point is the plot
+            args.plot = "artifacts/comparison.png"
+        with open(args.replot) as f:
+            res = json.load(f)
+    else:
+        res = run_comparison(K=args.K, Nloop=args.Nloop, Nadmm=args.Nadmm,
+                             batch=args.batch, n_train=args.n_train,
+                             n_test=args.n_test, seed=args.seed,
+                             synthetic_noise=args.noise,
+                             synthetic_prototypes=args.prototypes, log=print)
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(res, f, indent=1)
+        print(f"wrote {args.out}")
+    if args.plot:
+        write_plot(res, args.plot)
+        print(f"wrote {args.plot}")
     print(json.dumps(res["final"]))
-    print(f"wrote {args.out}")
     return res
 
 

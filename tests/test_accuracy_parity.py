@@ -48,3 +48,21 @@ class TestPublishedOrdering:
             c = results[name]
             assert len(c) >= 2
             assert c[-1] >= c[0] - 1.0, f"{name} curve fell: {c}"
+
+
+class TestComparisonPlot:
+    def test_write_plot(self, tmp_path):
+        from federated_pytorch_test_tpu.drivers.accuracy_comparison import (
+            write_plot,
+        )
+        stub = {
+            "config": {"K": 10},
+            "data_source": "synthetic",
+            "standalone": [20.0, 50.0, 70.0],
+            "fedavg": [25.0, 80.0, 99.0],
+            "consensus": [12.0, 60.0, 97.0],
+            "upper_k1": [30.0, 90.0, 99.5],
+        }
+        out = tmp_path / "comparison.png"
+        write_plot(stub, str(out))
+        assert out.exists() and out.stat().st_size > 10_000
