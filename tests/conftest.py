@@ -34,3 +34,9 @@ from federated_pytorch_test_tpu.utils.compile_cache import (  # noqa: E402
 
 enable_persistent_compile_cache(os.path.join(os.path.dirname(__file__),
                                              ".jax_cache"))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy end-to-end training tests (quick loop: -m 'not slow')")

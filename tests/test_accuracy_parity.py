@@ -19,6 +19,7 @@ def results():
                           n_test=512, seed=5)
 
 
+@pytest.mark.slow
 class TestPublishedOrdering:
     def test_all_well_above_chance(self, results):
         f = results["final"]

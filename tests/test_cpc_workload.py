@@ -80,6 +80,7 @@ class TestLofarPipeline:
 
 
 class TestCPCTrainer:
+    @pytest.mark.slow
     def test_rotation_trains_all_submodels(self):
         from federated_pytorch_test_tpu.train.cpc_engine import CPCTrainer
         src = CPCDataSource(["a.h5", "b.h5"], ["0", "1"], batch_size=2)

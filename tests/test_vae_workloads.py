@@ -171,6 +171,7 @@ class TestWeightedPartialBatch:
         np.testing.assert_allclose(padded, true, rtol=1e-5)
 
 
+@pytest.mark.slow
 class TestVaeDrivers:
     def test_vae_driver_smoke(self, tmp_path, monkeypatch):
         monkeypatch.chdir(tmp_path)
