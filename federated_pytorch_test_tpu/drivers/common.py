@@ -60,15 +60,19 @@ def config_from_args(args: argparse.Namespace) -> FederatedConfig:
     return FederatedConfig(**kw)
 
 
-def enable_compile_cache() -> None:
-    """Driver-entry compile-cache setup: TPU compiles of the per-block
-    epoch dominate cold runs, so every CLI enables the shared persistent
-    cache first thing (VAE/CPC mains call this too)."""
+def setup_runtime(cfg: FederatedConfig) -> None:
+    """One driver-entry chokepoint, called before the first device query:
+    enable the shared persistent compile cache (TPU compiles of the
+    per-block epoch dominate cold runs), join the multi-host runtime when
+    requested, and honor the ``use_tpu`` platform gate (``apply_platform``).
+    Every CLI main routes through here (the CPC main passes its argparse
+    namespace — only ``.use_tpu`` is read)."""
     from federated_pytorch_test_tpu.utils.compile_cache import (
         enable_persistent_compile_cache,
     )
 
     enable_persistent_compile_cache()
+    apply_platform(cfg)
 
 
 def apply_platform(cfg: FederatedConfig) -> None:
@@ -78,8 +82,8 @@ def apply_platform(cfg: FederatedConfig) -> None:
     already initialized on a non-CPU platform, warns instead of failing.
 
     Also joins the multi-host runtime first when ``FEDTPU_DISTRIBUTED=1``
-    (parallel/mesh.py:initialize_multihost) — every driver routes through
-    here before its first device query.
+    (parallel/mesh.py:initialize_multihost).  Drivers reach this via
+    ``setup_runtime``.
     """
     from federated_pytorch_test_tpu.parallel.mesh import initialize_multihost
 
@@ -153,8 +157,7 @@ def run_classifier_driver(prog: str, defaults: FederatedConfig,
                           argv=None):
     args = build_parser(defaults, prog).parse_args(argv)
     cfg = config_from_args(args)
-    enable_compile_cache()
-    apply_platform(cfg)
+    setup_runtime(cfg)
     trainer = make_trainer(cfg, algorithm, args.n_train, args.n_test)
     print(f"{prog}: K={cfg.K} model={'ResNet18' if cfg.use_resnet else 'Net'} "
           f"devices={trainer.D} clients/device={trainer.K_local} "

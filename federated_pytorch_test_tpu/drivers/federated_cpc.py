@@ -47,13 +47,9 @@ def main(argv=None):
     p.add_argument("--checkpoint-dir", default="./checkpoints")
     args = p.parse_args(argv)
 
-    from federated_pytorch_test_tpu.drivers.common import (
-        apply_platform,
-        enable_compile_cache,
-    )
+    from federated_pytorch_test_tpu.drivers.common import setup_runtime
 
-    enable_compile_cache()
-    apply_platform(args)                 # duck-typed: needs .use_tpu only
+    setup_runtime(args)                  # duck-typed: needs .use_tpu only
     data = CPCDataSource(args.file_list, args.sap_list,
                          batch_size=args.batch_size,
                          patch_size=args.patch_size, seed=args.seed)

@@ -18,8 +18,7 @@ DEFAULTS = FederatedConfig(K=10, Nloop=12, Nepoch=1, Nadmm=3,
 def main(argv=None):
     args = common.build_parser(DEFAULTS, "federated_vae").parse_args(argv)
     cfg = common.config_from_args(args)
-    common.enable_compile_cache()
-    common.apply_platform(cfg)
+    common.setup_runtime(cfg)
     data = FederatedCifar10(
         K=cfg.K, batch=cfg.default_batch, biased_input=cfg.biased_input,
         drop_last_sample=cfg.drop_last_sample, data_dir=cfg.data_dir,

@@ -28,8 +28,7 @@ def main(argv=None):
                    help="latent dimension (federated_vae_cl.py:23)")
     args = p.parse_args(argv)
     cfg = common.config_from_args(args)
-    common.enable_compile_cache()
-    common.apply_platform(cfg)
+    common.setup_runtime(cfg)
     data = FederatedCifar10(
         K=cfg.K, batch=cfg.default_batch, biased_input=cfg.biased_input,
         drop_last_sample=cfg.drop_last_sample, data_dir=cfg.data_dir,

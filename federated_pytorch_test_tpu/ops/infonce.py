@@ -100,6 +100,11 @@ def _log_p_kernel(P: int, z_ref, zhat_ref, out_ref):
     out_ref[0, :] = diag - lse
 
 
+def _padded_dims(D: int, P: int) -> tuple:
+    """(D_pad, P_pad): D to the f32 sublane multiple, P to the row tile."""
+    return pl.cdiv(D, _SUBLANE) * _SUBLANE, pl.cdiv(P, _TILE) * _TILE
+
+
 def _pallas_fits(D_pad: int, P_pad: int) -> bool:
     per_program = 4 * (D_pad * (_TILE + P_pad) + _TILE * P_pad)
     return per_program <= _VMEM_BUDGET
@@ -108,8 +113,7 @@ def _pallas_fits(D_pad: int, P_pad: int) -> bool:
 def _log_p_pallas(Z: jnp.ndarray, Zhat: jnp.ndarray,
                   interpret: bool = False) -> jnp.ndarray:
     D, P = Z.shape
-    P_pad = pl.cdiv(P, _TILE) * _TILE
-    D_pad = pl.cdiv(D, _SUBLANE) * _SUBLANE
+    D_pad, P_pad = _padded_dims(D, P)
     Zp = jnp.pad(Z, ((0, D_pad - D), (0, P_pad - P)))
     Zhp = jnp.pad(Zhat, ((0, D_pad - D), (0, P_pad - P)))
     out = pl.pallas_call(
@@ -129,9 +133,7 @@ def _log_p_pallas(Z: jnp.ndarray, Zhat: jnp.ndarray,
 def _dispatch_log_p(Z: jnp.ndarray, Zhat: jnp.ndarray) -> jnp.ndarray:
     impl = _FORCE_IMPL
     if impl is None:
-        D, P = Z.shape
-        fits = _pallas_fits(pl.cdiv(D, _SUBLANE) * _SUBLANE,
-                            pl.cdiv(P, _TILE) * _TILE)
+        fits = _pallas_fits(*_padded_dims(*Z.shape))
         impl = "pallas" if (jax.default_backend() == "tpu" and fits) else "xla"
     if impl == "xla":
         return log_p_flat(Z, Zhat)          # shared core, train/cpc_losses.py
