@@ -4,7 +4,12 @@ Prints ONE JSON line with the headline metric plus characterization fields:
 
   {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N,
    "full_round_ips_chip": N, "big_block_ips_chip": N, "big_block_N": N,
-   "mfu": N, "chip": "..."}
+   "mfu": N, "chip": "...", "infonce_pallas_us": N, "infonce_xla_us": N,
+   "infonce_speedup": N}
+
+(the infonce_* fields — the Pallas-fused CPC loss kernel vs its XLA path,
+ops/infonce.py — appear only on TPU and are try/except-guarded so they can
+never break the headline artifact)
 
 The reference publishes no quantitative numbers (BASELINE.md); the driver-set
 target is >=5,000 CIFAR10 images/sec/chip for the consensus ResNet18 config
@@ -144,6 +149,44 @@ def main():
     big_block = bench_block(big_ci)
     full_round = bench_block(big_ci, with_comm=True)
 
+    def bench_infonce():
+        """Pallas-fused vs XLA InfoNCE forward (ops/infonce.py) at a
+        grid-spanning shape (P=256 -> two row tiles); microseconds/call."""
+        from federated_pytorch_test_tpu.ops.infonce import (
+            force_infonce_impl,
+            info_nce_fused,
+        )
+
+        rng = np.random.default_rng(0)
+        z = jnp.asarray(rng.normal(size=(16, 16, 16, 32)).astype(np.float32))
+        zh = jnp.asarray(rng.normal(size=(16, 16, 16, 32)).astype(np.float32))
+        out = {}
+        for impl in ("pallas", "xla"):
+            with force_infonce_impl(impl):
+                fn = jax.jit(info_nce_fused)
+                np.asarray(fn(z, zh))          # compile + sync
+                t0 = time.perf_counter()
+                r = None
+                for _ in range(30):
+                    r = fn(z, zh)
+                np.asarray(r)                  # host fetch = real sync
+                out[impl] = (time.perf_counter() - t0) / 30 * 1e6
+        return out
+
+    infonce = {}
+    try:                       # never let the kernel microbench break the
+        if jax.default_backend() == "tpu":     # headline artifact
+            t = bench_infonce()
+            infonce = {"infonce_pallas_us": round(t["pallas"], 1),
+                       "infonce_xla_us": round(t["xla"], 1),
+                       "infonce_speedup": round(t["xla"] / t["pallas"], 3)}
+    except Exception as e:
+        # stderr, not stdout: the artifact stays one JSON line, but a
+        # kernel regression is visible instead of reading like a CPU run
+        import sys
+        print(f"bench_infonce failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
     dev = jax.devices()[0]
     # MFU from the analytic model-FLOP count (the standard definition):
     # CIFAR ResNet18 forward ~0.56 GMAC/image (3x3 stem @32x32: 1.8 MMAC;
@@ -163,6 +206,7 @@ def main():
         "big_block_N": sizes[big_ci],
         "mfu": round(mfu, 4),
         "chip": getattr(dev, "device_kind", str(dev)),
+        **infonce,
     }))
 
 
