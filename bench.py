@@ -163,7 +163,11 @@ def main():
         out = {}
         for impl in ("pallas", "xla"):
             with force_infonce_impl(impl):
-                fn = jax.jit(info_nce_fused)
+                # fresh lambda per impl: JAX's jaxpr cache is keyed on the
+                # raw function object and does not see _FORCE_IMPL, so
+                # jitting info_nce_fused directly would reuse the first
+                # impl's trace for both timings
+                fn = jax.jit(lambda a, b: info_nce_fused(a, b))
                 np.asarray(fn(z, zh))          # compile + sync
                 t0 = time.perf_counter()
                 r = None

@@ -98,6 +98,13 @@ def initialize_multihost() -> bool:
     return jax.process_count() > 1
 
 
+def _process_count() -> int:
+    """Indirection over ``jax.process_count`` so tests can force the
+    multi-process branches below without patching the jax module itself
+    (``multihost_utils`` must keep seeing the true count)."""
+    return jax.process_count()
+
+
 def stage_global(x, sharding: NamedSharding):
     """Host array -> global device array under ``sharding``.
 
@@ -107,7 +114,7 @@ def stage_global(x, sharding: NamedSharding):
     only this process's addressable shards — each host feeds its own
     slice of the client axis, nothing is sent over DCN at staging time.
     """
-    if jax.process_count() == 1:
+    if _process_count() == 1:
         return jax.device_put(x, sharding)
     return jax.make_array_from_callback(x.shape, sharding,
                                         lambda idx: x[idx])
@@ -126,7 +133,7 @@ def fetch(x):
     Single-process: ``np.asarray``.  Multi-process: client-sharded arrays
     have non-addressable shards, so all-gather across processes first.
     """
-    if jax.process_count() == 1:
+    if _process_count() == 1:
         return np.asarray(x)
     from jax.experimental import multihost_utils
 
