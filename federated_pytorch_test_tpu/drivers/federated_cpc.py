@@ -62,12 +62,13 @@ def main(argv=None):
     if args.load_model and os.path.isdir(os.path.abspath(
             os.path.expanduser(ckpt))):
         restored, _ = load_checkpoint(ckpt)
-        import jax
-        from federated_pytorch_test_tpu.parallel.mesh import client_sharding
+        from federated_pytorch_test_tpu.parallel.mesh import (
+            client_sharding,
+            stage_tree_global,
+        )
         csh = client_sharding(trainer.mesh)
-        state = type(state)(**{
-            k: jax.tree.map(lambda x: jax.device_put(x, csh), restored[k])
-            for k in restored})
+        state = type(state)(**{k: stage_tree_global(restored[k], csh)
+                               for k in restored})
         print(f"loaded checkpoint <- {ckpt}")
     state, history = trainer.run(Nloop=args.Nloop, Nadmm=args.Nadmm,
                                  state=state)

@@ -36,7 +36,9 @@ from federated_pytorch_test_tpu.parallel.mesh import (
     client_mesh,
     client_sharding,
     fetch,
+    replicated_sharding,
     stage_global,
+    stage_tree_global,
     usable_device_count,
 )
 from federated_pytorch_test_tpu.ops.infonce import info_nce_fused
@@ -96,8 +98,11 @@ class CPCTrainer:
 
         csh = client_sharding(mesh)
         stack = lambda t: jax.tree.map(
-            lambda v: jnp.broadcast_to(v[None], (self.K,) + v.shape), t)
-        self.state0 = CPCState(**{k: jax.device_put(stack(v), csh)
+            lambda v: np.broadcast_to(np.asarray(v)[None],
+                                      (self.K,) + v.shape), t)
+        # stage_tree_global: local-shards-only staging on multi-host and no
+        # per-leaf cross-process assert_equal collective (parallel/mesh.py)
+        self.state0 = CPCState(**{k: stage_tree_global(stack(v), csh)
                                   for k, v in params.items()})
         self._fn_cache: Dict[Any, Any] = {}
 
@@ -213,7 +218,8 @@ class CPCTrainer:
                         px, py, batch = self.data.round_batches(self.Niter)
                         fn, init_fn, N = self._build_round(mdl, ci, px, py)
                         if z is None:
-                            z = jnp.zeros((N,), jnp.float32)
+                            z = stage_global(np.zeros((N,), np.float32),
+                                             replicated_sharding(self.mesh))
                             opt_state = init_fn(state)
                         state, z, opt_state, dual, losses = fn(
                             state, z, opt_state,

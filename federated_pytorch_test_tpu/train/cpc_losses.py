@@ -26,9 +26,14 @@ def safe_norms(Z: jnp.ndarray) -> jnp.ndarray:
     (e.g. dead features early in training) yields 0/0 = NaN there
     (federated_cpc.py:160-166); guarding keeps every dispatch path of the
     fused op (ops/infonce.py) finite and mutually identical.
+
+    The guard sits INSIDE the sqrt: ``where`` on the squared sum makes the
+    VJP finite too (guarding after ``jnp.linalg.norm`` leaves the norm's
+    x/||x|| backward evaluating 0/0 = NaN at a zero column even though the
+    primal is masked, so autodiff through :func:`log_p_flat` would NaN).
     """
-    n = jnp.linalg.norm(Z, axis=0)
-    return jnp.where(n == 0.0, 1.0, n)
+    sq = jnp.sum(Z * Z, axis=0)
+    return jnp.sqrt(jnp.where(sq == 0.0, 1.0, sq))
 
 
 def log_p_flat(Z: jnp.ndarray, Zhat: jnp.ndarray) -> jnp.ndarray:

@@ -141,11 +141,15 @@ class BlockwiseFederatedTrainer:
         self.has_bn = bool(batch_stats)
 
         stack = lambda t: jax.tree.map(
-            lambda v: jnp.broadcast_to(v[None], (K,) + v.shape), t
+            lambda v: np.broadcast_to(np.asarray(v)[None], (K,) + v.shape), t
         )
         csh = client_sharding(mesh)
-        self.params0 = jax.device_put(stack(params), csh)
-        self.batch_stats0 = jax.device_put(stack(batch_stats), csh)
+        # stage_tree_global, not device_put: on multi-host each process
+        # materialises only its addressable client shards, and device_put of
+        # a host array onto a global sharding costs a cross-process
+        # assert_equal collective per call (parallel/mesh.py)
+        self.params0 = stage_tree_global(stack(params), csh)
+        self.batch_stats0 = stage_tree_global(stack(batch_stats), csh)
 
         self._fn_cache: Dict[Any, Any] = {}
         self._shuffle = np.random.default_rng(cfg.seed)
@@ -543,7 +547,7 @@ class BlockwiseFederatedTrainer:
 
         tree, meta = load_checkpoint(path)
         csh = client_sharding(self.mesh)
-        rsh = jax.sharding.NamedSharding(self.mesh, P())
+        rsh = replicated_sharding(self.mesh)
         put_c = lambda t: stage_tree_global(t, csh)
         put_r = lambda t: stage_tree_global(t, rsh)
         mid = bool(meta["mid_block"])
@@ -603,7 +607,7 @@ class BlockwiseFederatedTrainer:
         state = state or self.init_state()
         history: List[Dict[str, Any]] = []
         csh = client_sharding(self.mesh)
-        rsh = jax.sharding.NamedSharding(self.mesh, P())
+        rsh = replicated_sharding(self.mesh)
 
         resume_at = None
         slot = (self._midrun_slot(checkpoint_path)
@@ -629,21 +633,23 @@ class BlockwiseFederatedTrainer:
                     resume_at = None
                 else:
                     resume_at = None
-                    # fresh per-block state (federated_multi.py:148-159)
-                    z = jax.device_put(jnp.zeros((N,), jnp.float32), rsh)
+                    # fresh per-block state (federated_multi.py:148-159);
+                    # stage_global so multi-host stages local shards only
+                    z = stage_global(np.zeros((N,), np.float32), rsh)
                     ydim = N if algo.needs_dual else 1
-                    y = jax.device_put(
-                        jnp.zeros((cfg.K, ydim), jnp.float32), csh)
-                    rho = jax.device_put(jnp.float32(cfg.admm_rho0), rsh)
-                    x0 = jax.device_put(
-                        jnp.zeros((cfg.K, N if cfg.bb_update else 1),
-                                  jnp.float32), csh)
+                    y = stage_global(
+                        np.zeros((cfg.K, ydim), np.float32), csh)
+                    rho = stage_global(
+                        np.asarray(cfg.admm_rho0, np.float32), rsh)
+                    x0 = stage_global(
+                        np.zeros((cfg.K, N if cfg.bb_update else 1),
+                                 np.float32), csh)
                     # yhat0 init = params at block start (consensus_multi.py:184)
                     if cfg.bb_update:
                         yhat0 = self._build_gather(ci)(state.params)
                     else:
-                        yhat0 = jax.device_put(
-                            jnp.zeros((cfg.K, 1), jnp.float32), csh)
+                        yhat0 = stage_global(
+                            np.zeros((cfg.K, 1), np.float32), csh)
                     state = ClientState(state.params, state.batch_stats,
                                         init_opt(state.params))
 
@@ -720,10 +726,12 @@ class BlockwiseFederatedTrainer:
         state = state or self.init_state()
         train_epoch, _, init_opt = self._build_fns(None)
         history: List[Dict[str, Any]] = []
-        z = jnp.zeros((1,), jnp.float32)
-        y = jax.device_put(jnp.zeros((cfg.K, 1), jnp.float32),
-                           client_sharding(self.mesh))
-        rho = jnp.float32(cfg.admm_rho0)
+        z = stage_global(np.zeros((1,), np.float32),
+                         replicated_sharding(self.mesh))
+        y = stage_global(np.zeros((cfg.K, 1), np.float32),
+                         client_sharding(self.mesh))
+        rho = stage_global(np.asarray(cfg.admm_rho0, np.float32),
+                           replicated_sharding(self.mesh))
         for epoch in range(cfg.Nepoch):
             t_epoch = time.perf_counter()
             state = ClientState(state.params, state.batch_stats,

@@ -293,7 +293,14 @@ class TestMultihostHelpers:
     def test_multiprocess_branches_run(self, monkeypatch):
         """Force the process_count>1 code paths (make_array_from_callback
         staging, process_allgather fetch) — both execute fine in a single
-        process, so the branches get real coverage without a pod."""
+        process, so the branches get real coverage without a pod.
+
+        Caveat: the staged array here is fully addressable, so
+        ``process_allgather`` takes its host-local tiled-concat path — NOT
+        the replicate path a genuinely client-sharded pod array (with
+        non-addressable shards) takes.  This test therefore witnesses that
+        ``fetch`` calls process_allgather with ``tiled=True``, not the
+        pod-side behavior of process_allgather itself."""
         from federated_pytorch_test_tpu.parallel import mesh as meshmod
         monkeypatch.setattr(meshmod, "_process_count", lambda: 2)
         m = client_mesh(4)

@@ -90,3 +90,10 @@ class TestInfoNCEPallas:
         assert np.all(np.isfinite(np.asarray(gz)))
         np.testing.assert_allclose(np.asarray(gz2), np.asarray(gz),
                                    rtol=1e-4, atol=1e-6)
+        # autodiff straight through the XLA path (no custom VJP) must be
+        # finite too: safe_norms guards inside the sqrt, so the norm VJP
+        # cannot produce 0/0 at a zero column (train/cpc_losses.py)
+        gz3, _ = jax.grad(info_nce, argnums=(0, 1))(z, zhat)
+        assert np.all(np.isfinite(np.asarray(gz3)))
+        np.testing.assert_allclose(np.asarray(gz3), np.asarray(gz),
+                                   rtol=1e-4, atol=1e-6)
