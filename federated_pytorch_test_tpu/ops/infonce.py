@@ -21,6 +21,13 @@ the score matrix — unavoidable, the softmax Jacobian needs it — but no
 forward re-run and no logsumexp recompute), so the kernel drops into the
 CPC training closure (LBFGS re-evaluates value_and_grad inside
 ``lax.while_loop``) with no tracing restrictions and no extra forward.
+The backward is ALSO a Pallas kernel (``_grad_kernel``): the training
+path calls ``value_and_grad`` on every LBFGS closure evaluation, so the
+backward dominates wall-clock — it rebuilds each [T, P] score-matrix
+row tile in VMEM, forms the softmax-Jacobian product there, and writes
+only the [D, P] gradients to HBM (the XLA backward materialises several
+P x P intermediates).  The dZhat term needs a sum over row tiles; the
+kernel accumulates it across the sequential TPU grid.
 
 Dispatch: the Pallas path runs when the default backend is TPU and the
 working set fits the VMEM budget; otherwise the XLA path runs (identical
@@ -110,6 +117,15 @@ def _pallas_fits(D_pad: int, P_pad: int) -> bool:
     return per_program <= _VMEM_BUDGET
 
 
+def _pallas_bwd_fits(D_pad: int, P_pad: int) -> bool:
+    """VMEM estimate for ``_grad_kernel``: Z tile + dZ tile [D, T] each,
+    Zhat + dZhat accumulator + dZhat partial [D, P] each, and ~4 [T, P]
+    score-sized temporaries (zz, s, G, Gn)."""
+    per_program = 4 * (2 * D_pad * _TILE + 3 * D_pad * P_pad
+                       + 4 * _TILE * P_pad)
+    return per_program <= _VMEM_BUDGET
+
+
 def _log_p_pallas(Z: jnp.ndarray, Zhat: jnp.ndarray,
                   interpret: bool = False) -> jnp.ndarray:
     D, P = Z.shape
@@ -131,13 +147,24 @@ def _log_p_pallas(Z: jnp.ndarray, Zhat: jnp.ndarray,
 
 
 def _dispatch_log_p(Z: jnp.ndarray, Zhat: jnp.ndarray) -> jnp.ndarray:
-    impl = _FORCE_IMPL
-    if impl is None:
-        fits = _pallas_fits(*_padded_dims(*Z.shape))
-        impl = "pallas" if (jax.default_backend() == "tpu" and fits) else "xla"
+    impl = _resolve_impl(_pallas_fits(*_padded_dims(*Z.shape)))
     if impl == "xla":
         return log_p_flat(Z, Zhat)          # shared core, train/cpc_losses.py
     return _log_p_pallas(Z, Zhat, interpret=impl == "pallas_interpret")
+
+
+def _resolve_impl(fits: bool) -> str:
+    """"xla" | "pallas" | "pallas_interpret" for this call site.
+
+    ``fits`` is the caller's VMEM estimate; forward and backward have
+    different working sets, so under auto dispatch a shape can run the
+    fused forward while its backward falls back to XLA (results agree
+    either way).  A forced impl (tests, benches) wins unconditionally.
+    """
+    impl = _FORCE_IMPL
+    if impl is None:
+        return "pallas" if (jax.default_backend() == "tpu" and fits) else "xla"
+    return impl
 
 
 @jax.custom_vjp
@@ -148,6 +175,110 @@ def _fused_flat(Z: jnp.ndarray, Zhat: jnp.ndarray) -> jnp.ndarray:
 def _fused_flat_fwd(Z, Zhat):
     log_p = _dispatch_log_p(Z, Zhat)
     return _loss_from_log_p(log_p), (Z, Zhat, log_p)
+
+
+def _grads_xla(Z, Zhat, log_p, ghat):
+    """XLA backward (the fallback path of ``_dispatch_grads``)."""
+    # same zero-norm guard as every forward path (cpc_losses.safe_norms):
+    # a guarded column has zz ≡ 0, so the norm-path terms (dzn/dzhn)
+    # vanish and only the finite numerator path contributes — no NaNs
+    zn = safe_norms(Z)
+    zhn = safe_norms(Zhat)
+    denom = zn[:, None] * zhn[None, :]
+    zz = (Z.T @ Zhat) / denom
+    lse = jnp.diag(zz) - log_p
+    s = jnp.exp(zz - lse[:, None])                    # softmax rows
+    G = ghat[:, None] * (jnp.eye(zz.shape[0], dtype=zz.dtype) - s)
+    Gn = G / denom
+    dzn = -jnp.sum(G * zz, axis=1) / zn
+    dzhn = -jnp.sum(G * zz, axis=0) / zhn
+    dZ = Zhat @ Gn.T + Z * (dzn / zn)[None, :]
+    dZhat = Z @ Gn + Zhat * (dzhn / zhn)[None, :]
+    return dZ, dZhat
+
+
+def _grad_kernel(P: int, z_ref, zhat_ref, logp_ref, ghat_ref,
+                 dz_ref, dzhat_ref):
+    """One [T, P_pad] row tile of the backward: rebuild the tile's scores,
+    form the softmax-Jacobian product G in VMEM, and emit this tile's
+    [D_pad, T] slab of dZ plus its additive contribution to dZhat.
+
+    dZhat needs a sum over ALL row tiles (column reduction of G); the TPU
+    grid runs sequentially, so the kernel accumulates into ``dzhat_ref``
+    (initialised by the first program).  Pad rows are inert by
+    construction: their ghat is staged as 0, so their G row vanishes; pad
+    columns are masked out of the softmax like the forward.
+    """
+    i = pl.program_id(0)
+    a = z_ref[:, :]            # [D_pad, T]   this tile's columns of Z
+    zh = zhat_ref[:, :]        # [D_pad, P_pad]
+    logp = logp_ref[0, :]      # [T]
+    ghat = ghat_ref[0, :]      # [T]          0 on pad rows
+    zn = jnp.sqrt(jnp.sum(a * a, axis=0))       # [T]
+    zhn = jnp.sqrt(jnp.sum(zh * zh, axis=0))    # [P_pad]
+    zn = jnp.where(zn == 0.0, 1.0, zn)          # cpc_losses.safe_norms
+    zhn = jnp.where(zhn == 0.0, 1.0, zhn)
+    denom = zn[:, None] * zhn[None, :]
+    zz = jax.lax.dot_general(
+        a, zh, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) / denom                                   # [T, P_pad]
+
+    t = zz.shape[0]
+    col = jax.lax.broadcasted_iota(jnp.int32, zz.shape, 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, zz.shape, 0) + i * t
+    on_diag = col == row
+    diag = jnp.sum(jnp.where(on_diag, zz, 0.0), axis=1)      # [T]
+    lse = diag - logp                           # forward residual identity
+    # pad rows: zz ≡ 0 (zero Z column, guarded norm) and logp staged 0, so
+    # lse = 0 and s stays bounded — no inf/NaN can leak into the masked G
+    s = jnp.where(col < P, jnp.exp(zz - lse[:, None]), 0.0)
+    G = ghat[:, None] * (jnp.where(on_diag, 1.0, 0.0) - s)   # [T, P_pad]
+    Gn = G / denom
+    dzn = -jnp.sum(G * zz, axis=1) / (zn * zn)               # [T]
+    dz_ref[:, :] = jax.lax.dot_general(
+        zh, Gn, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + a * dzn[None, :]
+    part = jax.lax.dot_general(
+        a, Gn, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + zh * (-jnp.sum(G * zz, axis=0) / (zhn * zhn))[None, :]
+
+    @pl.when(i == 0)
+    def _init():
+        dzhat_ref[:, :] = part
+
+    @pl.when(i > 0)
+    def _acc():
+        dzhat_ref[:, :] += part
+
+
+def _grads_pallas(Z, Zhat, log_p, ghat, interpret: bool = False):
+    D, P = Z.shape
+    D_pad, P_pad = _padded_dims(D, P)
+    pad2 = lambda m: jnp.pad(m, ((0, D_pad - D), (0, P_pad - P)))
+    pad_row = lambda v: jnp.pad(v, (0, P_pad - P))[None, :]
+    dZ, dZhat = pl.pallas_call(
+        functools.partial(_grad_kernel, P),
+        grid=(P_pad // _TILE,),
+        in_specs=[
+            pl.BlockSpec((D_pad, _TILE), lambda i: (0, i)),
+            pl.BlockSpec((D_pad, P_pad), lambda i: (0, 0)),
+            pl.BlockSpec((1, _TILE), lambda i: (0, i)),
+            pl.BlockSpec((1, _TILE), lambda i: (0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((D_pad, _TILE), lambda i: (0, i)),
+            pl.BlockSpec((D_pad, P_pad), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((D_pad, P_pad), jnp.float32),
+            jax.ShapeDtypeStruct((D_pad, P_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(pad2(Z), pad2(Zhat), pad_row(log_p), pad_row(ghat))
+    return dZ[:D, :P], dZhat[:D, :P]
 
 
 def _fused_flat_bwd(res, ct):
@@ -164,27 +295,18 @@ def _fused_flat_bwd(res, ct):
         ghat_i    = -ct * exp(g_i) / (exp(g_i) + 1e-6)
 
     then the quotient rule routes dL/dzz into Z, Zhat both through the
-    Gram numerator and the column norms.
+    Gram numerator and the column norms.  On TPU the whole product is a
+    Pallas kernel (``_grad_kernel``) — the [P, P] intermediates (scores,
+    softmax, G) live only in VMEM, tile by tile.
     """
     Z, Zhat, log_p = res
-    # same zero-norm guard as every forward path (cpc_losses.safe_norms):
-    # a guarded column has zz ≡ 0, so the norm-path terms (dzn/dzhn)
-    # vanish and only the finite numerator path contributes — no NaNs
-    zn = safe_norms(Z)
-    zhn = safe_norms(Zhat)
-    denom = zn[:, None] * zhn[None, :]
-    zz = (Z.T @ Zhat) / denom
-    lse = jnp.diag(zz) - log_p
-    s = jnp.exp(zz - lse[:, None])                    # softmax rows
     c = jnp.exp(log_p)
     ghat = -ct * c / (c + 1e-6)                       # [P]
-    G = ghat[:, None] * (jnp.eye(zz.shape[0], dtype=zz.dtype) - s)
-    Gn = G / denom
-    dzn = -jnp.sum(G * zz, axis=1) / zn
-    dzhn = -jnp.sum(G * zz, axis=0) / zhn
-    dZ = Zhat @ Gn.T + Z * (dzn / zn)[None, :]
-    dZhat = Z @ Gn + Zhat * (dzhn / zhn)[None, :]
-    return dZ, dZhat
+    impl = _resolve_impl(_pallas_bwd_fits(*_padded_dims(*Z.shape)))
+    if impl == "xla":
+        return _grads_xla(Z, Zhat, log_p, ghat)
+    return _grads_pallas(Z, Zhat, log_p, ghat,
+                         interpret=impl == "pallas_interpret")
 
 
 _fused_flat.defvjp(_fused_flat_fwd, _fused_flat_bwd)

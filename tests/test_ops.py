@@ -11,6 +11,7 @@ import numpy as np
 import pytest
 
 from federated_pytorch_test_tpu.ops.infonce import (
+    _pallas_bwd_fits,
     _pallas_fits,
     force_infonce_impl,
     info_nce_fused,
@@ -39,15 +40,53 @@ class TestInfoNCEPallas:
         # and both equal the plain train/cpc_losses implementation
         np.testing.assert_allclose(want, float(info_nce(z, zhat)), rtol=1e-5)
 
-    def test_gradients_flow_through_kernel(self):
-        z = _rand((2, 2, 2, 3), 2)
-        zhat = _rand((2, 2, 2, 3), 3)
+    @pytest.mark.parametrize("B,px,py,R", [
+        (2, 2, 2, 3),      # P=4 — single tile, heavy padding
+        (2, 12, 12, 3),    # P=144 — two row tiles: exercises the backward
+                           # kernel's cross-tile dZhat accumulation
+    ])
+    def test_gradients_flow_through_kernel(self, B, px, py, R):
+        z = _rand((B, px, py, R), 2)
+        zhat = _rand((B, px, py, R), 3)
         with force_infonce_impl("pallas_interpret"):
             gz, gzh = jax.grad(info_nce_fused, argnums=(0, 1))(z, zhat)
         wz, wzh = jax.grad(info_nce, argnums=(0, 1))(z, zhat)
         np.testing.assert_allclose(np.asarray(gz), np.asarray(wz), rtol=1e-4,
                                    atol=1e-6)
         np.testing.assert_allclose(np.asarray(gzh), np.asarray(wzh),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_backward_kernel_scales_with_cotangent(self):
+        """The VJP threads the incoming cotangent through ghat; a scaled
+        downstream loss must scale the Pallas-kernel gradients exactly."""
+        z = _rand((2, 3, 3, 4), 8)
+        zhat = _rand((2, 3, 3, 4), 9)
+        with force_infonce_impl("pallas_interpret"):
+            g1 = jax.grad(lambda a, b: info_nce_fused(a, b))(z, zhat)
+            g3 = jax.grad(lambda a, b: 3.0 * info_nce_fused(a, b))(z, zhat)
+        np.testing.assert_allclose(np.asarray(g3), 3 * np.asarray(g1),
+                                   rtol=1e-5)
+
+    def test_value_and_grad_under_scan(self):
+        """The CPC LBFGS closure calls value_and_grad inside lax.scan under
+        jit — both Pallas kernels (fwd + bwd) must trace cleanly there."""
+        z = _rand((2, 2, 2, 3), 10)
+        zhat = _rand((2, 2, 2, 3), 11)
+
+        @jax.jit
+        def scanned(z, zhat):
+            def step(c, _):
+                v, g = jax.value_and_grad(info_nce_fused)(z, zhat)
+                return (c[0] + v, c[1] + g), None
+            (v, g), _ = jax.lax.scan(
+                step, (jnp.float32(0), jnp.zeros_like(z)), None, length=2)
+            return v, g
+
+        with force_infonce_impl("pallas_interpret"):
+            v, g = scanned(z, zhat)
+        wv, wg = jax.value_and_grad(info_nce)(z, zhat)
+        np.testing.assert_allclose(float(v), 2 * float(wv), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(g), 2 * np.asarray(wg),
                                    rtol=1e-4, atol=1e-6)
 
     def test_kernel_works_under_jit_and_scan(self):
@@ -71,6 +110,8 @@ class TestInfoNCEPallas:
     def test_vmem_guard(self):
         assert _pallas_fits(128, 256)
         assert not _pallas_fits(200_000, 8192)   # would blow VMEM
+        assert _pallas_bwd_fits(512, 256)        # the CPC training shape
+        assert not _pallas_bwd_fits(200_000, 8192)
 
     def test_zero_norm_column_finite_and_consistent(self):
         """A dead (all-zero) patch column must give the same finite loss
