@@ -1,45 +1,63 @@
 """Headline benchmark: federated CIFAR10 training throughput on TPU.
 
-Prints ONE JSON line with the headline metric plus characterization fields:
+Prints ONE JSON line, ALWAYS — even when the TPU backend is unreachable
+(the axon relay is known to wedge transiently; rounds 1 and 3 lost their
+perf artifact to an unguarded first device query).  Backend acquisition is
+a bounded subprocess probe + retry; on genuine unavailability the artifact
+still appears, with an ``"error"`` field and ``value = 0``:
 
   {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N,
-   "full_round_ips_chip": N, "big_block_ips_chip": N, "big_block_N": N,
-   "mfu": N, "chip": "...", "infonce_pallas_us": N, "infonce_xla_us": N,
-   "infonce_speedup": N}
+   "stem_block_ips_chip": N, "big_block_ips_chip": N, "big_block_N": N,
+   "no_consensus_ips_chip": N, "mfu": N, "chip": "...",
+   "infonce_pallas_us": N, "infonce_xla_us": N, "infonce_speedup": N,
+   "infonce_grad_pallas_us": N, "infonce_grad_xla_us": N,
+   "infonce_grad_speedup": N}
 
-(the infonce_* fields — the Pallas-fused CPC loss kernel vs its XLA path,
-ops/infonce.py — appear only on TPU and are try/except-guarded so they can
-never break the headline artifact)
+The reference publishes no quantitative numbers (BASELINE.md); the
+driver-set target is >=5,000 CIFAR10 images/sec/chip for the consensus
+ResNet18 config (BASELINE.json), so ``vs_baseline`` is value / 5000.
 
-The reference publishes no quantitative numbers (BASELINE.md); the driver-set
-target is >=5,000 CIFAR10 images/sec/chip for the consensus ResNet18 config
-(BASELINE.json), so ``vs_baseline`` is value / 5000.
+HEADLINE (``value``): sustained throughput of one FULL consensus round on
+the largest ResNet18 partition — Nepoch=1 local epoch + ADMM collective +
+dual update + z write-back, INCLUDING the per-epoch host->device staging
+(shuffle + uint8 copy) a production round pays.  This is what a user of
+the reference's end-to-end loop (federated_multi.py:143-220) experiences.
+Side fields characterise the parts:
 
-Three measurements on the real production path (jitted shard_map epoch of the
-ADMM-consensus ResNet18 driver), all with data staged once:
+  * stem_block_ips_chip: local-epoch-only throughput on the stem block
+    ci=0 (N=1,856), data staged once — the sliver rounds 1-3 headlined,
+    kept for cross-round comparability.  It flatters: gradient masking
+    lets XLA prune most of the backward.
+  * big_block_ips_chip: local-epoch-only throughput on the LARGEST
+    ResNet18 partition (reference block [54,59], N=4,720,640), staged
+    once.
+  * no_consensus_ips_chip: full-net epoch (every parameter trainable,
+    the no_consensus driver's path), staged once.
 
-  * headline: local-epoch throughput on the stem block ci=0 (N=1,856) — the
-    same sliver round 1/2 measured, kept for cross-round comparability;
-  * big block: the LARGEST ResNet18 partition (reference block [54,59],
-    N=4,720,640 of 11.2M params, resnet18_partition consensus path) —
-    masked grads + Adam epoch on a communication-heavy block;
-  * full consensus round: Nepoch local epoch + ADMM comm round (psum
-    average, dual update, z write-back).  Data is staged once and PRNG
-    keys reused, so per-epoch host->device staging is NOT in this number
-    (a production round additionally pays one uint8 epoch copy).
+MFU is computed from ``no_consensus_ips_chip`` ONLY: with the whole net
+trainable the executed graph is the full fwd + 2x bwd, so the analytic
+ResNet18 model-FLOP count is the FLOPs actually executed (XLA's
+cost_analysis undercounts fused TPU convolutions ~13x here, so the
+analytic count is used).  Masked-block throughputs are NOT converted to
+MFU — their backward is partially pruned and any full-FLOP MFU would
+overstate sustained throughput (this replaces the round-2/3 headline MFU,
+which multiplied the pruned stem-block rate by unpruned FLOPs).
 
-MFU is computed from the analytic ResNet18 model-FLOP count against the
-chip's peak bf16 rate (XLA's cost_analysis undercounts fused TPU
-convolutions ~13x here and recompiling the executable to query it blows
-the bench's time budget, so it is not used).
+The infonce_* fields time the Pallas-fused CPC loss kernel against its
+XLA path (ops/infonce.py) — forward alone and value_and_grad (the CPC
+LBFGS closure evaluates the latter, so the grad timing is the one the
+training loop feels).  TPU-only; try/except-guarded so a kernel
+regression can never break the headline artifact.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
 import numpy as np
 
 TARGET = 5000.0  # images/sec/chip (BASELINE.json north star)
@@ -54,6 +72,52 @@ _PEAK_BF16 = {
     "TPU v6 lite": 918e12,
 }
 
+# analytic CIFAR ResNet18 step FLOPs/image: forward ~0.56 GMAC (3x3 stem
+# @32x32: 1.8 MMAC; layer1 4x 3x3x64x64 @32x32: 151 MMAC; layers2-4 ~134
+# MMAC each after stride-2 downsamples), train step ~3x forward (fwd +
+# 2x bwd) at 2 FLOPs/MAC
+_STEP_FLOPS_PER_IMAGE = 3 * 2 * 0.56e9
+
+_PROBE = "import jax; d = jax.devices(); assert d[0].platform == 'tpu', d"
+
+
+def _acquire_backend(attempts: int = 4, probe_timeout: float = 120.0,
+                     backoff: float = 20.0) -> str | None:
+    """Probe the TPU backend in a SUBPROCESS (bounded; the axon relay wedge
+    hangs the first in-process device query indefinitely, so an in-process
+    try/except cannot implement a retry).  On success return None and leave
+    the environment alone; after ``attempts`` failures force the CPU
+    backend for this process and return the error string.
+
+    Must run BEFORE the first ``import jax`` in this process.
+    """
+    if os.environ.get("FEDTPU_BENCH_FORCE_CPU") == "1":
+        err = "TPU skipped: FEDTPU_BENCH_FORCE_CPU=1"
+    else:
+        last = None
+        for attempt in range(attempts):
+            if attempt:
+                time.sleep(backoff)
+            try:
+                r = subprocess.run(
+                    [sys.executable, "-c", _PROBE],
+                    timeout=probe_timeout, capture_output=True, text=True)
+                if r.returncode == 0:
+                    return None
+                last = (r.stderr.strip().splitlines()
+                        or ["rc=%d" % r.returncode])[-1]
+            except subprocess.TimeoutExpired:
+                last = f"TPU probe hung >{probe_timeout:.0f}s (relay wedged?)"
+            print(f"bench: TPU probe {attempt + 1}/{attempts} failed: {last}",
+                  file=sys.stderr)
+        err = f"tpu backend unavailable after {attempts} probes: {last}"
+    # decouple from the axon plugin entirely: sitecustomize registers it
+    # whenever PALLAS_AXON_POOL_IPS is set and register() overrides
+    # JAX_PLATFORMS, so blank both knobs before jax is imported
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    return err
+
 
 def _peak_flops(device) -> float:
     kind = getattr(device, "device_kind", "")
@@ -63,24 +127,24 @@ def _peak_flops(device) -> float:
     return 197e12
 
 
-def main():
-    # the bench is compile-dominated (3 block specialisations of the
-    # ResNet18 epoch); share the persistent cache across driver runs
-    from federated_pytorch_test_tpu.utils.compile_cache import (
-        enable_persistent_compile_cache,
-    )
+def _measure(out: dict) -> None:
+    """All measurements; fills ``out`` incrementally so a late failure
+    still leaves the fields measured so far in the artifact."""
+    import jax
+    import jax.numpy as jnp
 
-    enable_persistent_compile_cache()
     from federated_pytorch_test_tpu.data.cifar10 import FederatedCifar10
     from federated_pytorch_test_tpu.models.resnet import ResNet18
-    from federated_pytorch_test_tpu.parallel.mesh import client_sharding
+    from federated_pytorch_test_tpu.parallel.mesh import (
+        client_sharding,
+        replicated_sharding,
+    )
     from federated_pytorch_test_tpu.train import (
         AdmmConsensus,
         BlockwiseFederatedTrainer,
         FederatedConfig,
+        NoConsensus,
     )
-
-    import jax.numpy as jnp
 
     n_chips = len(jax.devices())
     K = 16 * n_chips                    # 16 clients per chip (throughput knee)
@@ -96,122 +160,179 @@ def main():
     trainer = BlockwiseFederatedTrainer(ResNet18(dtype=jnp.bfloat16), cfg,
                                         data, AdmmConsensus())
 
-    csh = client_sharding(trainer.mesh)
-    rsh = jax.sharding.NamedSharding(trainer.mesh, jax.sharding.PartitionSpec())
-    xb, yb, wb = trainer._stage_epoch()
-    keys = trainer._epoch_keys()
     images_per_epoch = K * steps * batch
 
-    def bench_block(ci, reps=5, with_comm=False):
-        """images/sec/chip for block ci's local epoch; when ``with_comm``
-        also runs the ADMM comm round (+write-back) each rep."""
+    def bench_block(trainer, ci, reps=5, with_comm=False, with_staging=False):
+        """images/sec/chip for block ci's local epoch under ``trainer``'s
+        algorithm.  ``with_comm`` adds the comm round (+write-back) per
+        rep; ``with_staging`` pays the per-epoch host->device staging
+        (shuffle + uint8 copy + PRNG keys) inside the timed region — the
+        production round does."""
+        csh = client_sharding(trainer.mesh)
+        rsh = replicated_sharding(trainer.mesh)
+        if not with_staging:        # with_staging re-stages inside the loop
+            xb, yb, wb = trainer._stage_epoch()
+            keys = trainer._epoch_keys()
         train_epoch, comm_fns, init_opt = trainer._build_fns(ci)
         N = trainer.block_size(ci)
         state = trainer.init_state()
         state = state._replace(opt_state=init_opt(state.params))
-        z = jax.device_put(jnp.zeros((N,), jnp.float32), rsh)
-        y = jax.device_put(jnp.zeros((K, N), jnp.float32), csh)
+        # a non-communicating algorithm ignores z/y (penalty 0): keep them
+        # token-sized exactly like engine.run_independent does
+        zdim = N if trainer.algo.communicates else 1
+        ydim = N if trainer.algo.needs_dual else 1
+        z = jax.device_put(jnp.zeros((zdim,), jnp.float32), rsh)
+        y = jax.device_put(jnp.zeros((K, ydim), jnp.float32), csh)
         rho = jax.device_put(jnp.float32(cfg.admm_rho0), rsh)
         x0 = jax.device_put(jnp.zeros((K, 1), jnp.float32), csh)
         yhat0 = jax.device_put(jnp.zeros((K, 1), jnp.float32), csh)
 
         def round_(state, z, y, rho):
-            state, losses = train_epoch(state, y, trainer.client_norm, keys,
-                                        xb, yb, wb, z, rho)
+            if with_staging:
+                bx, by, bw = trainer._stage_epoch()
+                ks = trainer._epoch_keys()
+            else:
+                bx, by, bw, ks = xb, yb, wb, keys
+            state, losses = train_epoch(state, y, trainer.client_norm, ks,
+                                        bx, by, bw, z, rho)
             diag = None
             if with_comm:
                 state, z, y, rho, _, _, diag = comm_fns["plain"](
                     state, z, y, rho, x0, yhat0)
             return state, z, y, rho, losses, diag
 
-        # warm-up / compile.  NOTE: under the axon relay block_until_ready
-        # does not actually block; force a host fetch of a value that
-        # depends on the full computation instead.
+        def sync(losses, diag):
+            # NOTE: under the axon relay block_until_ready does not
+            # actually block; force a host fetch of values that depend on
+            # the full computation instead.
+            np.asarray(losses)
+            if diag is not None:
+                jax.tree.map(np.asarray, diag)
+
+        # warm-up / compile
         state, z, y, rho, losses, diag = round_(state, z, y, rho)
-        np.asarray(losses)
-        if diag is not None:
-            jax.tree.map(np.asarray, diag)
+        sync(losses, diag)
 
         t0 = time.perf_counter()
         for _ in range(reps):
             state, z, y, rho, losses, diag = round_(state, z, y, rho)
-        np.asarray(losses)          # sync: depends on every local step
-        if diag is not None:
-            jax.tree.map(np.asarray, diag)
+        sync(losses, diag)
         dt = time.perf_counter() - t0
         return reps * images_per_epoch / dt / n_chips
 
     # block sizes across the sweep; biggest = reference block [54,59]
     sizes = [trainer.block_size(ci) for ci in range(trainer.L)]
     big_ci = int(np.argmax(sizes))
+    out["big_block_N"] = sizes[big_ci]
+    dev = jax.devices()[0]
+    out["chip"] = getattr(dev, "device_kind", str(dev))
 
-    headline = bench_block(0)
-    big_block = bench_block(big_ci)
-    full_round = bench_block(big_ci, with_comm=True)
+    out["stem_block_ips_chip"] = round(bench_block(trainer, 0), 1)
+    out["big_block_ips_chip"] = round(bench_block(trainer, big_ci), 1)
 
-    def bench_infonce():
-        """Pallas-fused vs XLA InfoNCE forward (ops/infonce.py) at a
-        grid-spanning shape (P=256 -> two row tiles); microseconds/call."""
-        from federated_pytorch_test_tpu.ops.infonce import (
-            force_infonce_impl,
-            info_nce_fused,
-        )
+    # HEADLINE: the full production consensus round on the biggest block,
+    # staging included
+    headline = bench_block(trainer, big_ci, with_comm=True,
+                           with_staging=True)
+    out["value"] = round(headline, 1)
+    out["vs_baseline"] = round(headline / TARGET, 3)
 
-        rng = np.random.default_rng(0)
-        z = jnp.asarray(rng.normal(size=(16, 16, 16, 32)).astype(np.float32))
-        zh = jnp.asarray(rng.normal(size=(16, 16, 16, 32)).astype(np.float32))
-        out = {}
-        for impl in ("pallas", "xla"):
-            with force_infonce_impl(impl):
-                # fresh lambda per impl: JAX's jaxpr cache is keyed on the
-                # raw function object and does not see _FORCE_IMPL, so
-                # jitting info_nce_fused directly would reuse the first
-                # impl's trace for both timings
-                fn = jax.jit(lambda a, b: info_nce_fused(a, b))
-                np.asarray(fn(z, zh))          # compile + sync
+    # full-net epoch (the no_consensus driver's path): every parameter
+    # trainable and NO consensus penalty, so the executed graph is the
+    # full fwd + 2x bwd — the ONLY config whose analytic FLOP count equals
+    # executed FLOPs, hence the MFU basis
+    trainer_nc = BlockwiseFederatedTrainer(ResNet18(dtype=jnp.bfloat16),
+                                           cfg, data, NoConsensus())
+    full_net = bench_block(trainer_nc, None)
+    out["no_consensus_ips_chip"] = round(full_net, 1)
+    out["mfu"] = round(full_net * _STEP_FLOPS_PER_IMAGE / _peak_flops(dev), 4)
+
+    try:                       # never let the kernel microbench break the
+        if jax.default_backend() == "tpu":     # headline artifact
+            out.update(_bench_infonce())
+    except Exception as e:
+        # stderr, not stdout: the artifact stays one JSON line, but a
+        # kernel regression is visible instead of reading like a CPU run
+        print(f"bench_infonce failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
+
+def _bench_infonce() -> dict:
+    """Pallas-fused vs XLA InfoNCE (ops/infonce.py) at a grid-spanning
+    shape (P=256 -> two row tiles; D=512): microseconds/call for the
+    forward alone and for value_and_grad — the CPC LBFGS closure evaluates
+    the latter on every (re-)evaluation, so the grad number is the one the
+    training loop feels."""
+    import jax
+    import jax.numpy as jnp
+
+    from federated_pytorch_test_tpu.ops.infonce import (
+        force_infonce_impl,
+        info_nce_fused,
+    )
+
+    rng = np.random.default_rng(0)
+    z = jnp.asarray(rng.normal(size=(16, 16, 16, 32)).astype(np.float32))
+    zh = jnp.asarray(rng.normal(size=(16, 16, 16, 32)).astype(np.float32))
+    fwd_us, grad_us = {}, {}
+    for impl in ("pallas", "xla"):
+        with force_infonce_impl(impl):
+            # fresh lambdas per impl: JAX's jaxpr cache is keyed on the
+            # raw function object and does not see _FORCE_IMPL, so jitting
+            # info_nce_fused directly would reuse the first impl's trace
+            # for both timings
+            fns = {
+                "fwd": jax.jit(lambda a, b: info_nce_fused(a, b)),
+                "grad": jax.jit(
+                    lambda a, b: jax.value_and_grad(info_nce_fused,
+                                                    argnums=(0, 1))(a, b)),
+            }
+            for name, fn in fns.items():
+                jax.tree.map(np.asarray, fn(z, zh))    # compile + sync
                 t0 = time.perf_counter()
                 r = None
                 for _ in range(30):
                     r = fn(z, zh)
-                np.asarray(r)                  # host fetch = real sync
-                out[impl] = (time.perf_counter() - t0) / 30 * 1e6
-        return out
+                jax.tree.map(np.asarray, r)            # host fetch = sync
+                us = (time.perf_counter() - t0) / 30 * 1e6
+                (fwd_us if name == "fwd" else grad_us)[impl] = us
+    return {
+        "infonce_pallas_us": round(fwd_us["pallas"], 1),
+        "infonce_xla_us": round(fwd_us["xla"], 1),
+        "infonce_speedup": round(fwd_us["xla"] / fwd_us["pallas"], 3),
+        "infonce_grad_pallas_us": round(grad_us["pallas"], 1),
+        "infonce_grad_xla_us": round(grad_us["xla"], 1),
+        "infonce_grad_speedup": round(grad_us["xla"] / grad_us["pallas"], 3),
+    }
 
-    infonce = {}
-    try:                       # never let the kernel microbench break the
-        if jax.default_backend() == "tpu":     # headline artifact
-            t = bench_infonce()
-            infonce = {"infonce_pallas_us": round(t["pallas"], 1),
-                       "infonce_xla_us": round(t["xla"], 1),
-                       "infonce_speedup": round(t["xla"] / t["pallas"], 3)}
-    except Exception as e:
-        # stderr, not stdout: the artifact stays one JSON line, but a
-        # kernel regression is visible instead of reading like a CPU run
-        import sys
-        print(f"bench_infonce failed: {type(e).__name__}: {e}",
-              file=sys.stderr)
 
-    dev = jax.devices()[0]
-    # MFU from the analytic model-FLOP count (the standard definition):
-    # CIFAR ResNet18 forward ~0.56 GMAC/image (3x3 stem @32x32: 1.8 MMAC;
-    # layer1 4x 3x3x64x64 @32x32: 151 MMAC; layers2-4 ~134 MMAC each after
-    # the stride-2 downsamples), train step ~3x forward (fwd + 2x bwd) at
-    # 2 FLOPs/MAC
-    step_flops_per_image = 3 * 2 * 0.56e9
-    mfu = headline * step_flops_per_image / _peak_flops(dev)
-
-    print(json.dumps({
-        "metric": "cifar10_resnet18_consensus_train_throughput",
-        "value": round(headline, 1),
+def main():
+    out = {
+        "metric": "cifar10_resnet18_consensus_full_round_throughput",
+        "value": 0.0,
         "unit": "images/sec/chip",
-        "vs_baseline": round(headline / TARGET, 3),
-        "full_round_ips_chip": round(full_round, 1),
-        "big_block_ips_chip": round(big_block, 1),
-        "big_block_N": sizes[big_ci],
-        "mfu": round(mfu, 4),
-        "chip": getattr(dev, "device_kind", str(dev)),
-        **infonce,
-    }))
+        "vs_baseline": 0.0,
+    }
+    # probe BEFORE importing jax (the wedge hangs in-process init)
+    err = _acquire_backend()
+    if err is not None:
+        out["error"] = err
+    try:
+        # compile-dominated (4 block specialisations of the ResNet18
+        # epoch); share the persistent cache across driver runs
+        from federated_pytorch_test_tpu.utils.compile_cache import (
+            enable_persistent_compile_cache,
+        )
+
+        enable_persistent_compile_cache()
+        if err is None:
+            _measure(out)
+        # on CPU fallback: skip the measurements (a 1-core CPU run of the
+        # production config would take hours and the numbers would mean
+        # nothing) — the artifact itself still appears, rc=0
+    except Exception as e:          # noqa: BLE001 — artifact must survive
+        out["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,88 @@
+"""bench.py artifact contract: one JSON line, ALWAYS (VERDICT r3 weak #1 —
+rounds 1 and 3 lost their perf artifact to an unguarded device query when
+the TPU relay wedged)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+class TestAcquireBackend:
+    def test_probe_success_touches_nothing(self, monkeypatch):
+        calls = []
+
+        class R:
+            returncode = 0
+
+        monkeypatch.setattr(bench.subprocess, "run",
+                            lambda *a, **kw: calls.append(a) or R())
+        monkeypatch.delenv("FEDTPU_BENCH_FORCE_CPU", raising=False)
+        before = os.environ.get("JAX_PLATFORMS")
+        assert bench._acquire_backend() is None
+        assert len(calls) == 1
+        assert os.environ.get("JAX_PLATFORMS") == before
+
+    def test_probe_retry_is_bounded_and_falls_back_to_cpu(self, monkeypatch):
+        """A wedged relay hangs the probe subprocess; the loop must stop
+        after ``attempts`` tries, back off in between, and force the CPU
+        platform so the artifact still gets emitted."""
+        sleeps = []
+
+        def hang(*a, **kw):
+            raise subprocess.TimeoutExpired(cmd="probe", timeout=kw["timeout"])
+
+        monkeypatch.setattr(bench.subprocess, "run", hang)
+        monkeypatch.setattr(bench.time, "sleep", sleeps.append)
+        monkeypatch.delenv("FEDTPU_BENCH_FORCE_CPU", raising=False)
+        monkeypatch.setenv("JAX_PLATFORMS", "tpu")          # restored after
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "1.2.3.4")
+        err = bench._acquire_backend(attempts=3, probe_timeout=0.5,
+                                     backoff=7.0)
+        assert "after 3 probes" in err and "hung" in err
+        assert sleeps == [7.0, 7.0]                         # between probes
+        assert os.environ["JAX_PLATFORMS"] == "cpu"
+        assert os.environ["PALLAS_AXON_POOL_IPS"] == ""
+
+    def test_force_cpu_env_skips_probe(self, monkeypatch):
+        monkeypatch.setattr(
+            bench.subprocess, "run",
+            lambda *a, **kw: pytest.fail("probe must not run when forced"))
+        monkeypatch.setenv("FEDTPU_BENCH_FORCE_CPU", "1")
+        err = bench._acquire_backend()
+        assert "FEDTPU_BENCH_FORCE_CPU" in err
+
+
+class TestArtifact:
+    def test_always_emits_one_json_line(self):
+        """End-to-end: with the TPU unavailable (forced), bench.py must
+        exit 0 and print exactly one parseable JSON line carrying the
+        headline keys plus the error."""
+        env = dict(os.environ, FEDTPU_BENCH_FORCE_CPU="1")
+        r = subprocess.run([sys.executable, "bench.py"], cwd=REPO, env=env,
+                           capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stderr
+        lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+        assert len(lines) == 1, r.stdout
+        art = json.loads(lines[0])
+        for key in ("metric", "value", "unit", "vs_baseline", "error"):
+            assert key in art
+        assert art["unit"] == "images/sec/chip"
+
+    def test_measure_failure_still_emits(self, monkeypatch, capsys):
+        """An exception mid-measurement must not kill the artifact."""
+        monkeypatch.setattr(bench, "_acquire_backend", lambda: None)
+        monkeypatch.setattr(bench, "_measure",
+                            lambda out: (_ for _ in ()).throw(
+                                RuntimeError("chip fell over")))
+        bench.main()
+        art = json.loads(capsys.readouterr().out.strip())
+        assert art["value"] == 0.0
+        assert "chip fell over" in art["error"]
