@@ -18,7 +18,9 @@ from __future__ import annotations
 
 import hashlib
 import os
-from typing import List, Tuple
+import queue
+import threading
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -130,7 +132,9 @@ class CPCDataSource:
         self.sap_list = sap_list
         self.batch_size = batch_size
         self.patch_size = patch_size
+        self.seed = seed
         self._rng = np.random.default_rng(seed)
+        self._round = 0
 
     @property
     def K(self) -> int:
@@ -141,14 +145,88 @@ class CPCDataSource:
             self.file_list[ck], self.sap_list[ck], self.batch_size,
             self.patch_size, self._rng)
 
-    def round_batches(self, niter: int) -> Tuple[int, int, np.ndarray]:
-        """[K, niter, batch*px*py, patch, patch, 8] for one comm round."""
+    def round_batches(self, niter: int,
+                      clients: Optional[Sequence[int]] = None
+                      ) -> Tuple[int, int, np.ndarray]:
+        """[len(clients), niter, batch*px*py, patch, patch, 8] for one comm
+        round (``clients`` defaults to all K).
+
+        Random draws are keyed on ``(seed, round_counter, client)`` rather
+        than one shared sequential generator, so (a) the prefetching and
+        direct call paths see identical data, and (b) on multi-host, where
+        each process builds only ITS client subset (federated_cpc.py:137-145
+        assigns clients to hosts via the file list), the per-client streams
+        stay uncorrelated — a shared generator would hand every process the
+        same draw sequence starting at its first client.
+        """
+        clients = range(self.K) if clients is None else clients
+        rnd = self._round
+        self._round += 1
         out = []
         px = py = None
-        for ck in range(self.K):
+        for ck in clients:
+            rng = np.random.default_rng([self.seed, rnd, ck])
             its = []
             for _ in range(niter):
-                px, py, y = self.minibatch(ck)
+                px, py, y = get_data_minibatch(
+                    self.file_list[ck], self.sap_list[ck], self.batch_size,
+                    self.patch_size, rng)
                 its.append(y)
             out.append(np.stack(its))
         return px, py, np.stack(out)
+
+
+class RoundPrefetcher:
+    """Double-buffered background producer over
+    :meth:`CPCDataSource.round_batches` (SURVEY.md section 7 hard part 6:
+    the reference re-draws fresh minibatches per round on the host,
+    federated_cpc.py:252-253, which serialises host work against device
+    compute).  The producer thread builds round n+1's host tensor while
+    round n computes; ``Queue(maxsize=1)`` bounds host memory at ~2 rounds
+    in flight.
+    """
+
+    def __init__(self, source: CPCDataSource, niter: int, total_rounds: int,
+                 clients: Optional[Sequence[int]] = None):
+        self._q: queue.Queue = queue.Queue(maxsize=1)
+        self._stop = False
+        self._exc: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._produce, args=(source, niter, total_rounds, clients),
+            daemon=True)
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        """Bounded put that gives up when the consumer closed us."""
+        while not self._stop:
+            try:
+                self._q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce(self, source, niter, total, clients):
+        try:
+            for _ in range(total):
+                if not self._put(source.round_batches(niter, clients)):
+                    return
+        except BaseException as e:      # noqa: BLE001 — relayed to get()
+            self._exc = e
+            self._put(None)
+
+    def get(self) -> Tuple[int, int, np.ndarray]:
+        item = self._q.get()
+        if item is None:
+            raise RuntimeError("CPC prefetch producer failed") from self._exc
+        return item
+
+    def close(self) -> None:
+        """Unblock and retire the producer.
+
+        Joins the thread: it exits within one put-poll (~0.2s) of finishing
+        any in-flight ``round_batches`` build, and joining guarantees no
+        producer is still advancing the source's (unsynchronised) round
+        counter when the caller reuses the CPCDataSource."""
+        self._stop = True
+        self._thread.join()

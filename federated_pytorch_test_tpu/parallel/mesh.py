@@ -138,3 +138,32 @@ def fetch(x):
     from jax.experimental import multihost_utils
 
     return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
+def local_client_rows(mesh: Mesh, K: int) -> list:
+    """Sorted client-axis rows whose shards live on THIS process's devices.
+
+    The per-host data assignment: a host only needs to materialise (and a
+    data pipeline only needs to build) the client rows it will feed —
+    ``stage_client_rows`` turns that local slab into the global array.
+    Single-process this is simply ``range(K)``.
+    """
+    sh = client_sharding(mesh)
+    rows = set()
+    for idx in sh.addressable_devices_indices_map((K,)).values():
+        rows.update(range(*idx[0].indices(K)))
+    return sorted(rows)
+
+
+def stage_client_rows(x_local, sharding: NamedSharding):
+    """Host array holding ONLY this process's client rows (leading axis in
+    ``local_client_rows`` order) -> global device array under ``sharding``.
+
+    Complements :func:`stage_global` (which wants the FULL array on every
+    host): here each host hands over just its slab and nothing is copied
+    or compared across DCN at staging time.  Single-process the local slab
+    IS the full axis, so it is a plain ``device_put``.
+    """
+    if _process_count() == 1:
+        return jax.device_put(x_local, sharding)
+    return jax.make_array_from_process_local_data(sharding, x_local)

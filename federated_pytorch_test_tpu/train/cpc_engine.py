@@ -23,7 +23,7 @@ import numpy as np
 from jax import lax, shard_map
 from jax.sharding import PartitionSpec as P
 
-from federated_pytorch_test_tpu.data.lofar import CPCDataSource
+from federated_pytorch_test_tpu.data.lofar import CPCDataSource, RoundPrefetcher
 from federated_pytorch_test_tpu.models.cpc import (
     ContextgenCNN,
     EncoderCNN,
@@ -36,7 +36,9 @@ from federated_pytorch_test_tpu.parallel.mesh import (
     client_mesh,
     client_sharding,
     fetch,
+    local_client_rows,
     replicated_sharding,
+    stage_client_rows,
     stage_global,
     stage_tree_global,
     usable_device_count,
@@ -201,38 +203,70 @@ class CPCTrainer:
     # ------------------------------------------------------------------
     def run(self, Nloop: int = 1, Nadmm: int = 1,
             state: Optional[CPCState] = None,
-            log: Callable[[str], None] = print):
-        """The rotation loop (federated_cpc.py:194-304).  History records
-        carry per-round wall-clock (round_seconds) like the classifier
-        engine (SURVEY.md section 5 tracing)."""
+            log: Callable[[str], None] = print, prefetch: bool = True):
+        """The rotation loop (federated_cpc.py:194-304).
+
+        ``prefetch`` (default) double-buffers the host pipeline: a producer
+        thread builds round n+1's [K_local, Niter, ...] patch tensor while
+        round n computes on device (data/lofar.py:RoundPrefetcher) — the
+        data draws are (seed, round, client)-keyed, so the trajectory is
+        bit-identical with or without it.  On multi-host every process
+        builds and stages ONLY its addressable client rows
+        (local_client_rows / stage_client_rows, parallel/mesh.py).
+
+        History records split per-round wall-clock into ``stage_seconds``
+        (queue wait + host->device copy; with prefetch ~0 unless the host
+        pipeline is the bottleneck — visible starvation) and
+        ``compute_seconds`` (jitted round, device-synced), plus their sum
+        ``round_seconds`` (SURVEY.md section 5 tracing).
+        """
         state = state or self.state0
         history: List[Dict[str, Any]] = []
         csh = client_sharding(self.mesh)
-        for nloop in range(Nloop):
-            for mdl in SUBMODELS:
-                blocks = self.models[mdl].train_order_block_ids()
-                for ci in range(len(blocks)):
-                    z = opt_state = None
-                    for nadmm in range(Nadmm):
-                        t_round = time.perf_counter()
-                        px, py, batch = self.data.round_batches(self.Niter)
-                        fn, init_fn, N = self._build_round(mdl, ci, px, py)
-                        if z is None:
-                            z = stage_global(np.zeros((N,), np.float32),
-                                             replicated_sharding(self.mesh))
-                            opt_state = init_fn(state)
-                        state, z, opt_state, dual, losses = fn(
-                            state, z, opt_state,
-                            jax.tree.map(lambda b: stage_global(b, csh),
-                                         batch))
-                        rec = dict(nloop=nloop, model=mdl, block=ci,
-                                   nadmm=nadmm, N=N,
-                                   dual_residual=float(dual),
-                                   loss=float(np.sum(fetch(losses))),
-                                   round_seconds=(time.perf_counter()
-                                                  - t_round))
-                        history.append(rec)
-                        log(f"dual (N={N},loop={nloop},model={mdl},"
-                            f"block={ci},avg={nadmm})={rec['dual_residual']:e} "
-                            f"loss={rec['loss']:e}")
+        rows = local_client_rows(self.mesh, self.K)
+        n_rounds = Nloop * Nadmm * sum(
+            len(m.train_order_block_ids()) for m in self.models.values())
+        src = (RoundPrefetcher(self.data, self.Niter, n_rounds, clients=rows)
+               if prefetch else None)
+        try:
+            for nloop in range(Nloop):
+                for mdl in SUBMODELS:
+                    blocks = self.models[mdl].train_order_block_ids()
+                    for ci in range(len(blocks)):
+                        z = opt_state = None
+                        for nadmm in range(Nadmm):
+                            t_round = time.perf_counter()
+                            px, py, batch = (
+                                src.get() if src is not None
+                                else self.data.round_batches(self.Niter,
+                                                             clients=rows))
+                            fn, init_fn, N = self._build_round(mdl, ci, px,
+                                                               py)
+                            if z is None:
+                                z = stage_global(
+                                    np.zeros((N,), np.float32),
+                                    replicated_sharding(self.mesh))
+                                opt_state = init_fn(state)
+                            staged = stage_client_rows(batch, csh)
+                            t_staged = time.perf_counter()
+                            state, z, opt_state, dual, losses = fn(
+                                state, z, opt_state, staged)
+                            rec = dict(nloop=nloop, model=mdl, block=ci,
+                                       nadmm=nadmm, N=N,
+                                       dual_residual=float(dual),
+                                       loss=float(np.sum(fetch(losses))))
+                            # the float()/fetch above force a device sync,
+                            # so the stage/compute split is honest
+                            t_done = time.perf_counter()
+                            rec["stage_seconds"] = t_staged - t_round
+                            rec["compute_seconds"] = t_done - t_staged
+                            rec["round_seconds"] = t_done - t_round
+                            history.append(rec)
+                            log(f"dual (N={N},loop={nloop},model={mdl},"
+                                f"block={ci},avg={nadmm})="
+                                f"{rec['dual_residual']:e} "
+                                f"loss={rec['loss']:e}")
+        finally:
+            if src is not None:
+                src.close()
         return state, history

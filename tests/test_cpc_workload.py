@@ -78,6 +78,67 @@ class TestLofarPipeline:
         px, py, batch = src.round_batches(niter=2)
         assert batch.shape == (2, 2, 2 * px * py, 32, 32, 8)
 
+    def test_round_batches_draws_keyed_per_round_and_client(self):
+        """(seed, round, client)-keyed draws: a client-subset build must
+        reproduce the full build's rows exactly (multi-host: each process
+        builds only its clients), and successive rounds must differ."""
+        a = CPCDataSource(["a.h5", "b.h5", "c.h5"], ["0", "0", "0"],
+                          batch_size=2, seed=3)
+        b = CPCDataSource(["a.h5", "b.h5", "c.h5"], ["0", "0", "0"],
+                          batch_size=2, seed=3)
+        _, _, full = a.round_batches(niter=2)
+        _, _, sub = b.round_batches(niter=2, clients=[1, 2])
+        np.testing.assert_array_equal(sub, full[1:])
+        _, _, full2 = a.round_batches(niter=2)          # round counter bumped
+        assert not np.array_equal(full, full2)
+
+    def test_round_prefetcher_matches_direct_calls(self):
+        from federated_pytorch_test_tpu.data.lofar import RoundPrefetcher
+
+        direct = CPCDataSource(["a.h5", "b.h5"], ["0", "1"], batch_size=2,
+                               seed=11)
+        want = [direct.round_batches(2) for _ in range(3)]
+        pre_src = CPCDataSource(["a.h5", "b.h5"], ["0", "1"], batch_size=2,
+                                seed=11)
+        pre = RoundPrefetcher(pre_src, niter=2, total_rounds=3)
+        try:
+            for px, py, batch in want:
+                gpx, gpy, got = pre.get()
+                assert (gpx, gpy) == (px, py)
+                np.testing.assert_array_equal(got, batch)
+        finally:
+            pre.close()
+
+    def test_round_prefetcher_relays_producer_failure(self):
+        from federated_pytorch_test_tpu.data.lofar import RoundPrefetcher
+
+        class Boom:
+            def round_batches(self, niter, clients=None):
+                raise ValueError("disk on fire")
+
+        pre = RoundPrefetcher(Boom(), niter=1, total_rounds=1)
+        with pytest.raises(RuntimeError, match="producer failed"):
+            pre.get()
+        pre.close()
+
+    def test_local_client_rows_single_process_is_all(self):
+        from federated_pytorch_test_tpu.parallel.mesh import (
+            client_mesh,
+            local_client_rows,
+        )
+
+        mesh = client_mesh(4)
+        assert local_client_rows(mesh, 8) == list(range(8))
+
+    def test_stage_client_rows_roundtrip(self):
+        from federated_pytorch_test_tpu.parallel import mesh as meshmod
+
+        mesh = meshmod.client_mesh(4)
+        sh = meshmod.client_sharding(mesh)
+        x = np.arange(8 * 3, dtype=np.float32).reshape(8, 3)
+        np.testing.assert_array_equal(
+            np.asarray(meshmod.stage_client_rows(x, sh)), x)
+
 
 class TestCPCTrainer:
     @pytest.mark.slow
@@ -90,3 +151,25 @@ class TestCPCTrainer:
         assert models == {"encoder", "contextgen", "predictor"}
         assert all(np.isfinite(h["dual_residual"]) for h in hist)
         assert all(np.isfinite(h["loss"]) for h in hist)
+        # the stage/compute wall-clock split is recorded per round
+        assert all(h["stage_seconds"] >= 0 and h["compute_seconds"] >= 0
+                   and h["round_seconds"] >= h["compute_seconds"]
+                   for h in hist)
+
+    @pytest.mark.slow
+    def test_prefetch_matches_direct_trajectory(self):
+        """The (seed, round, client)-keyed draws make the prefetched and
+        direct pipelines bit-identical — losses and residuals must agree
+        exactly (only the *_seconds timing fields may differ)."""
+        from federated_pytorch_test_tpu.train.cpc_engine import CPCTrainer
+
+        def run(prefetch):
+            src = CPCDataSource(["a.h5", "b.h5"], ["0", "1"], batch_size=2,
+                                seed=4)
+            t = CPCTrainer(src, latent_dim=8, reduced_dim=4, Niter=1)
+            _, hist = t.run(Nloop=1, Nadmm=1, log=lambda m: None,
+                            prefetch=prefetch)
+            return [{k: v for k, v in h.items()
+                     if not k.endswith("_seconds")} for h in hist]
+
+        assert run(True) == run(False)
