@@ -38,6 +38,8 @@ def build_parser(defaults: FederatedConfig, prog: str) -> argparse.ArgumentParse
                            default=default)
         elif f.name == "optimizer":
             p.add_argument(arg, choices=("adam", "lbfgs"), default=default)
+        elif f.name == "norm":
+            p.add_argument(arg, choices=("batch", "group"), default=default)
         elif default is None:
             conv = _optional_types.get(f.name)
             if conv is None:
@@ -105,7 +107,8 @@ def make_trainer(cfg: FederatedConfig, algorithm: Algorithm,
                  n_test: Optional[int] = None) -> BlockwiseFederatedTrainer:
     import jax.numpy as jnp
     dtype = jnp.bfloat16 if cfg.bf16 else None
-    model = ResNet18(dtype=dtype) if cfg.use_resnet else Net(dtype=dtype)
+    model = (ResNet18(dtype=dtype, norm=cfg.norm) if cfg.use_resnet
+             else Net(dtype=dtype))
     data = FederatedCifar10(
         K=cfg.K, batch=cfg.default_batch, biased_input=cfg.biased_input,
         drop_last_sample=cfg.drop_last_sample, data_dir=cfg.data_dir,

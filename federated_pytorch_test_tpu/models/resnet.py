@@ -7,6 +7,17 @@ blocks and federation averaging, exactly as torch's ``net.parameters()``
 includes BN weight/bias; running stats live in the ``batch_stats`` collection,
 stay per-client and are never averaged (matching torch, where buffers are not
 in ``parameters()``; see SURVEY.md section 7 "BatchNorm under federation").
+
+``norm="group"`` swaps every BatchNorm for a GroupNorm (32 groups) at the
+SAME module name, so the parameter enumeration order, the hand-made block
+partitions and all block tooling are unchanged.  This removes the BN caveat
+above for pod-scale federation (SURVEY.md section 7 hard part 4 "consider
+GroupNorm"): GroupNorm has no running statistics, so ALL normalisation
+state is ordinary parameters that federate like any other — clients drift
+only through weights, never through unaveraged buffers — and train/eval
+behavior is identical (no use_running_average split).  The reference has
+no such option; the per-client-stats BatchNorm (default) remains the
+parity configuration.
 """
 
 from __future__ import annotations
@@ -19,12 +30,15 @@ import jax.numpy as jnp
 from federated_pytorch_test_tpu.models.base import BlockModule, elu, pairs
 
 
-def _bn(name: str):
-    # torch BatchNorm2d defaults: eps=1e-5, momentum=0.1 (flax momentum=0.9).
-    # BN always computes in float32 (params are float32 too) — only the
-    # convs/dense run in the compute dtype.
+def _apply_norm(norm: str, name: str, x, train: bool):
+    """BatchNorm (torch defaults: eps=1e-5, momentum=0.1 -> flax 0.9) or
+    GroupNorm(32) under the SAME module name.  Normalisation always
+    computes in float32 — only the convs/dense run in the compute dtype."""
+    if norm == "group":
+        return nn.GroupNorm(num_groups=32, epsilon=1e-5, dtype=jnp.float32,
+                            name=name)(x)
     return nn.BatchNorm(momentum=0.9, epsilon=1e-5, dtype=jnp.float32,
-                        name=name)
+                        name=name)(x, use_running_average=not train)
 
 
 class BasicBlock(nn.Module):
@@ -37,6 +51,7 @@ class BasicBlock(nn.Module):
     stride: int = 1
     expansion: int = 1
     dtype: Optional[Any] = None   # compute dtype for convs (bf16 on TPU)
+    norm: str = "batch"           # "batch" (parity) | "group" (pod-safe)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
@@ -44,15 +59,15 @@ class BasicBlock(nn.Module):
         out = nn.Conv(self.planes, (3, 3), strides=(self.stride, self.stride),
                       padding="SAME", use_bias=False, dtype=self.dtype,
                       name="conv1")(x)
-        out = elu(_bn("bn1")(out, use_running_average=not train))
+        out = elu(_apply_norm(self.norm, "bn1", out, train))
         out = nn.Conv(self.planes, (3, 3), padding="SAME", use_bias=False,
                       dtype=self.dtype, name="conv2")(out)
-        out = _bn("bn2")(out, use_running_average=not train)
+        out = _apply_norm(self.norm, "bn2", out, train)
         if self.stride != 1 or in_planes != self.expansion * self.planes:
             sc = nn.Conv(self.expansion * self.planes, (1, 1),
                          strides=(self.stride, self.stride), use_bias=False,
                          dtype=self.dtype, name="shortcut_conv")(x)
-            sc = _bn("shortcut_bn")(sc, use_running_average=not train)
+            sc = _apply_norm(self.norm, "shortcut_bn", sc, train)
         else:
             sc = x
         return elu(out + sc)
@@ -69,25 +84,26 @@ class Bottleneck(nn.Module):
     stride: int = 1
     expansion: int = 4
     dtype: Optional[Any] = None
+    norm: str = "batch"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
         in_planes = x.shape[-1]
         out = nn.Conv(self.planes, (1, 1), use_bias=False, dtype=self.dtype,
                       name="conv1")(x)
-        out = elu(_bn("bn1")(out, use_running_average=not train))
+        out = elu(_apply_norm(self.norm, "bn1", out, train))
         out = nn.Conv(self.planes, (3, 3), strides=(self.stride, self.stride),
                       padding="SAME", use_bias=False, dtype=self.dtype,
                       name="conv2")(out)
-        out = elu(_bn("bn2")(out, use_running_average=not train))
+        out = elu(_apply_norm(self.norm, "bn2", out, train))
         out = nn.Conv(self.expansion * self.planes, (1, 1), use_bias=False,
                       dtype=self.dtype, name="conv3")(out)
-        out = _bn("bn3")(out, use_running_average=not train)
+        out = _apply_norm(self.norm, "bn3", out, train)
         if self.stride != 1 or in_planes != self.expansion * self.planes:
             sc = nn.Conv(self.expansion * self.planes, (1, 1),
                          strides=(self.stride, self.stride), use_bias=False,
                          dtype=self.dtype, name="shortcut_conv")(x)
-            sc = _bn("shortcut_bn")(sc, use_running_average=not train)
+            sc = _apply_norm(self.norm, "shortcut_bn", sc, train)
         else:
             sc = x
         return elu(out + sc)
@@ -107,12 +123,15 @@ class ResNet(BlockModule):
     #: compute dtype for convs/dense (params stay float32; BN and the loss
     #: run in float32).  bfloat16 feeds the MXU at full rate on TPU.
     dtype: Optional[Any] = None
+    #: "batch" = reference parity (per-client running stats, see module
+    #: docstring); "group" = GroupNorm(32), no stats, pod-scale safe
+    norm: str = "batch"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
         out = nn.Conv(64, (3, 3), padding="SAME", use_bias=False,
                       dtype=self.dtype, name="conv1")(x)
-        out = elu(_bn("bn1")(out, use_running_average=not train))
+        out = elu(_apply_norm(self.norm, "bn1", out, train))
         block_cls = Bottleneck if self.bottleneck else BasicBlock
         for stage, (planes, stride, n) in enumerate(
             zip(_STAGE_PLANES, _STAGE_STRIDES, self.num_blocks), start=1
@@ -120,6 +139,7 @@ class ResNet(BlockModule):
             strides = [stride] + [1] * (n - 1)
             for i, s in enumerate(strides):
                 out = block_cls(planes=planes, stride=s, dtype=self.dtype,
+                                norm=self.norm,
                                 name=f"layer{stage}_{i}")(out, train=train)
         out = nn.avg_pool(out, window_shape=(4, 4), strides=(4, 4))
         out = out.reshape((out.shape[0], -1))
@@ -167,11 +187,13 @@ class ResNet(BlockModule):
         return []
 
 
-def ResNet18(dtype=None) -> ResNet:
+def ResNet18(dtype=None, norm: str = "batch") -> ResNet:
     """Reference simple_models.py:233-234."""
-    return ResNet(num_blocks=(2, 2, 2, 2), qualifier=18, dtype=dtype)
+    return ResNet(num_blocks=(2, 2, 2, 2), qualifier=18, dtype=dtype,
+                  norm=norm)
 
 
-def ResNet9(dtype=None) -> ResNet:
+def ResNet9(dtype=None, norm: str = "batch") -> ResNet:
     """Reference simple_models.py:236-237."""
-    return ResNet(num_blocks=(1, 1, 1, 1), qualifier=9, dtype=dtype)
+    return ResNet(num_blocks=(1, 1, 1, 1), qualifier=9, dtype=dtype,
+                  norm=norm)

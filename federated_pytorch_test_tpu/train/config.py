@@ -42,6 +42,10 @@ class FederatedConfig:
     be_verbose: bool = False
     use_resnet: bool = False
     use_tpu: bool = True           # reference `use_cuda` (BASELINE.json rename)
+    # ResNet normalisation: "batch" = reference parity (per-client running
+    # stats); "group" = GroupNorm(32), stat-free and pod-scale safe
+    # (models/resnet.py module docstring).  Ignored by the BN-free Net.
+    norm: str = "batch"
 
     # adaptive-ADMM Barzilai-Borwein knobs (consensus_multi.py:41-47)
     bb_update: bool = False

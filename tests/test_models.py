@@ -144,6 +144,48 @@ class TestResNet:
         new = mutated["batch_stats"]["bn1"]["mean"]
         assert not np.allclose(old, new)
 
+    @pytest.mark.parametrize("factory,n_entries", [(ResNet18, 62),
+                                                   (ResNet9, 38)])
+    def test_groupnorm_variant_same_order_no_stats(self, factory, n_entries):
+        """norm='group' keeps the module names, hence the exact parameter
+        enumeration and block partitions — but carries NO running stats
+        (the pod-scale BN alternative, models/resnet.py docstring)."""
+        model = factory(norm="group")
+        params, batch_stats = init_model(model, jnp.zeros(CIFAR),
+                                         train=False)
+        assert batch_stats == {}                 # stat-free
+        order = model.param_order()
+        assert len(order) == n_entries
+        assert sorted(order) == sorted(p for p, _ in iter_paths(params))
+        out = model.apply({"params": params}, jnp.zeros(CIFAR), train=False)
+        assert out.shape == (2, 10)
+        # train and eval are the same function — no mode split
+        out_t = model.apply({"params": params}, jnp.zeros(CIFAR), train=True)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(out_t))
+
+    def test_groupnorm_trains_under_engine(self):
+        """End-to-end: the engine sees has_bn=False and the GN ResNet runs
+        a consensus round on the client mesh."""
+        from federated_pytorch_test_tpu.data.cifar10 import FederatedCifar10
+        from federated_pytorch_test_tpu.models.resnet import ResNet9
+        from federated_pytorch_test_tpu.train import (
+            AdmmConsensus,
+            BlockwiseFederatedTrainer,
+            FederatedConfig,
+        )
+
+        cfg = FederatedConfig(K=4, Nloop=1, Nepoch=1, Nadmm=1,
+                              default_batch=4, check_results=False,
+                              admm_rho0=0.1, norm="group")
+        data = FederatedCifar10(K=4, batch=4, limit_per_client=8,
+                                limit_test=4)
+        trainer = BlockwiseFederatedTrainer(ResNet9(norm="group"), cfg, data,
+                                            AdmmConsensus())
+        assert not trainer.has_bn
+        trainer.L = 1
+        state, hist = trainer.run(log=lambda m: None)
+        assert len(hist) == 1 and np.isfinite(hist[0]["dual_residual"])
+
 
 class TestVAE:
     def test_forward_shapes(self):
