@@ -170,6 +170,10 @@ def _measure(out: dict) -> None:
         production round does."""
         csh = client_sharding(trainer.mesh)
         rsh = replicated_sharding(trainer.mesh)
+        # epoch prefetch (the production path) stays on only when staging
+        # is part of the measurement; otherwise the worker thread would
+        # build a never-consumed epoch during the timed region
+        trainer._prefetch_epochs = with_staging
         if not with_staging:        # with_staging re-stages inside the loop
             xb, yb, wb = trainer._stage_epoch()
             keys = trainer._epoch_keys()

@@ -152,7 +152,17 @@ class BlockwiseFederatedTrainer:
         self.batch_stats0 = stage_tree_global(stack(batch_stats), csh)
 
         self._fn_cache: Dict[Any, Any] = {}
-        self._shuffle = np.random.default_rng(cfg.seed)
+        # stateless per-epoch randomness: epochs are keyed on a counter
+        # (see _epoch_seed), so the NEXT epoch's host-side shuffle/gather
+        # can be built on a worker thread while the devices compute this
+        # round (_stage_epoch), and mid-run resume only needs the counter
+        self._epochs_staged = 0
+        self._keys_staged = 0
+        self._prefetch_epochs = True
+        self._pending: Optional[tuple] = None
+        import concurrent.futures
+        self._stage_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="epoch-stage")
 
         # test set staged once: uint8 replicated across the mesh, labels and
         # pad weights replicated, per-client normalisation stats sharded
@@ -461,11 +471,41 @@ class BlockwiseFederatedTrainer:
                     self.test_x, self.test_y, self.test_w)
         return self.eval_finalize(fetch(totals), self.test_n)
 
-    def _stage_epoch(self):
-        # every process draws the same shuffle (seed-deterministic), so on
+    def _epoch_seed(self, counter: int, stream: int) -> int:
+        """Deterministic seed keyed on (config seed, epoch counter, stream).
+
+        Stateless by design: epoch ``c``'s data is a pure function of
+        ``c``, so the prefetcher can build epochs ahead of the consumer
+        and a mid-run checkpoint only has to record the counter (the
+        previous sequential-generator scheme made the staged-one-ahead
+        state unserialisable)."""
+        return int(np.random.default_rng(
+            [self.cfg.seed, counter, stream]).integers(2**31))
+
+    def _host_epoch(self, counter: int):
+        """Host-side (numpy) shuffle + gather for epoch ``counter`` — the
+        expensive part of staging, safe to run on the worker thread."""
+        return self.data.epoch_batches_raw(self._epoch_seed(counter, 0))
+
+    def _stage_epoch(self, last: bool = False):
+        # every process builds the same shuffle (seed-deterministic), so on
         # multi-host each stages only its addressable client shards
-        xb, yb, wb = self.data.epoch_batches_raw(
-            int(self._shuffle.integers(2**31)))
+        c = self._epochs_staged
+        self._epochs_staged += 1
+        if self._pending is not None and self._pending[0] == c:
+            xb, yb, wb = self._pending[1].result()
+        else:                        # first epoch / after resume: build now
+            xb, yb, wb = self._host_epoch(c)
+        self._pending = None
+        if self._prefetch_epochs and not last:
+            # overlap epoch c+1's permutation/gather with this round's
+            # device compute; the counter-keyed seed makes the result
+            # identical whether or not the future is ever consumed.
+            # ``last`` (the run's provably-final epoch) suppresses the
+            # submit: a trailing build would be wasted work whose
+            # dataset-sized result stays pinned until the trainer dies
+            self._pending = (c + 1,
+                             self._stage_pool.submit(self._host_epoch, c + 1))
         sh = client_sharding(self.mesh)
         return (stage_global(xb, sh), stage_global(yb, sh),
                 stage_global(wb, sh))
@@ -473,7 +513,9 @@ class BlockwiseFederatedTrainer:
     def _epoch_keys(self):
         """Per-client PRNG keys [K, 2] for this epoch (reparam sampling —
         replaces torch.cuda.FloatTensor.normal_, simple_models.py:292-301)."""
-        base = jax.random.PRNGKey(int(self._shuffle.integers(2**31)))
+        c = self._keys_staged
+        self._keys_staged += 1
+        base = jax.random.PRNGKey(self._epoch_seed(c, 1))
         keys = jax.random.split(base, self.cfg.K)
         keys = np.asarray(jax.random.key_data(keys))
         return stage_global(keys, client_sharding(self.mesh))
@@ -524,8 +566,11 @@ class BlockwiseFederatedTrainer:
         meta = {
             "nloop": nloop, "ci": ci, "nadmm": nadmm,
             "mid_block": int(mid_block),
-            "rng": np.frombuffer(
-                pickle.dumps(self._shuffle.bit_generator.state), np.uint8),
+            # per-epoch randomness is keyed on these counters
+            # (_epoch_seed), so they are the ENTIRE data-order state —
+            # resume replays the exact epoch sequence
+            "epochs_staged": self._epochs_staged,
+            "keys_staged": self._keys_staged,
             "history": np.frombuffer(pickle.dumps(history), np.uint8),
         }
         # crash-safe swap: never delete the only complete checkpoint while
@@ -567,8 +612,17 @@ class BlockwiseFederatedTrainer:
                          put_r(tree["rho"]), put_c(tree["x0"]),
                          put_c(tree["yhat0"]))
         state = ClientState(params, put_c(tree["batch_stats"]), opt)
-        self._shuffle.bit_generator.state = pickle.loads(
-            np.asarray(meta["rng"], np.uint8).tobytes())
+        if "epochs_staged" not in meta:
+            raise RuntimeError(
+                "mid-run checkpoint predates the counter-keyed epoch "
+                "staging (old pickled-generator format) and cannot be "
+                "resumed by this build; restart the run or load the "
+                "end-of-run checkpoint instead")
+        self._epochs_staged = int(meta["epochs_staged"])
+        self._keys_staged = int(meta["keys_staged"])
+        # a pending prefetched epoch stays valid across restore IF its
+        # counter matches (epochs are pure functions of the counter);
+        # _stage_epoch's counter check handles both cases
         history = pickle.loads(np.asarray(meta["history"], np.uint8).tobytes())
         return state, blockvars, (int(meta["nloop"]), int(meta["ci"]),
                                   int(meta["nadmm"]), mid), history
@@ -656,10 +710,18 @@ class BlockwiseFederatedTrainer:
                 for nadmm in range(nadmm_start, cfg.Nadmm):
                     t_round = time.perf_counter()
                     loss_sum = 0.0
+                    stage_s = 0.0
                     for nepoch in range(cfg.Nepoch):
-                        xb, yb, wb = self._stage_epoch()
+                        t_stage = time.perf_counter()
+                        xb, yb, wb = self._stage_epoch(
+                            last=(nloop == cfg.Nloop - 1
+                                  and ci == self.L - 1
+                                  and nadmm == cfg.Nadmm - 1
+                                  and nepoch == cfg.Nepoch - 1))
+                        keys = self._epoch_keys()
+                        stage_s += time.perf_counter() - t_stage
                         state, losses = train_epoch(
-                            state, y, self.client_norm, self._epoch_keys(),
+                            state, y, self.client_norm, keys,
                             xb, yb, wb, z, rho)
                         loss_sum += float(np.sum(fetch(losses)))
                         if cfg.be_verbose:
@@ -684,10 +746,14 @@ class BlockwiseFederatedTrainer:
                     else:
                         diag = {}
                     # per-round wall-clock (epochs + collective; the float()
-                    # fetches above force a device sync so this is honest)
+                    # fetches above force a device sync so this is honest).
+                    # stage_seconds isolates host shuffle + H2D copy — with
+                    # the epoch prefetch it should stay near zero unless
+                    # the host pipeline is the bottleneck
                     rec = dict(nloop=nloop, block=ci, nadmm=nadmm, N=N,
                                loss=loss_sum, rho=float(rho),
                                round_seconds=time.perf_counter() - t_round,
+                               stage_seconds=stage_s,
                                **diag)
                     if cfg.check_results:
                         rec["accuracy"] = self.evaluate(state)
@@ -736,7 +802,7 @@ class BlockwiseFederatedTrainer:
             t_epoch = time.perf_counter()
             state = ClientState(state.params, state.batch_stats,
                                 init_opt(state.params))
-            xb, yb, wb = self._stage_epoch()
+            xb, yb, wb = self._stage_epoch(last=epoch == cfg.Nepoch - 1)
             state, losses = train_epoch(state, y, self.client_norm,
                                         self._epoch_keys(), xb, yb, wb, z,
                                         rho)

@@ -247,6 +247,38 @@ class TestTracing:
         assert hits, "no xplane trace written"
 
 
+class TestEpochPrefetch:
+    def test_prefetch_matches_direct_trajectory(self, data):
+        """Epoch data is a pure function of (cfg.seed, counter), so runs
+        with the staging worker thread on and off must be bit-identical
+        (engine._stage_epoch)."""
+        strip = lambda h: [{k: v for k, v in r.items()
+                            if not k.endswith("seconds")} for r in h]
+
+        def run(prefetch):
+            t = BlockwiseFederatedTrainer(Net(), small_cfg(Nepoch=2), data,
+                                          AdmmConsensus())
+            t._prefetch_epochs = prefetch
+            _, hist = t.run(log=lambda m: None)
+            return strip(hist)
+
+        assert run(True) == run(False)
+
+    def test_epoch_seeds_differ_across_counter_and_stream(self, data):
+        t = BlockwiseFederatedTrainer(Net(), small_cfg(), data, FedAvg())
+        assert t._epoch_seed(0, 0) != t._epoch_seed(1, 0)
+        assert t._epoch_seed(0, 0) != t._epoch_seed(0, 1)
+        assert t._epoch_seed(3, 0) == t._epoch_seed(3, 0)
+
+    def test_no_trailing_prefetch_after_run(self, data):
+        """The run's final epoch must not queue a never-consumed build
+        (its dataset-sized result would stay pinned on the trainer)."""
+        t = BlockwiseFederatedTrainer(Net(), small_cfg(), data,
+                                      AdmmConsensus())
+        t.run(log=lambda m: None)
+        assert t._pending is None
+
+
 class TestMultihostHelpers:
     """stage_global / fetch (parallel/mesh.py): single-process they reduce
     to device_put / np.asarray; the multi-process branch's callback slicing
