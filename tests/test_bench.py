@@ -65,7 +65,12 @@ class TestArtifact:
         """End-to-end: with the TPU unavailable (forced), bench.py must
         exit 0 and print exactly one parseable JSON line carrying the
         headline keys plus the error."""
-        env = dict(os.environ, FEDTPU_BENCH_FORCE_CPU="1")
+        # drop any FEDTPU_BENCH_* knobs leaked from the developer's shell
+        # (e.g. MEASURE_ON_CPU=1 from the documented validation recipe
+        # would run the production-scale measurement on this CPU)
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith("FEDTPU_BENCH_")}
+        env["FEDTPU_BENCH_FORCE_CPU"] = "1"
         r = subprocess.run([sys.executable, "bench.py"], cwd=REPO, env=env,
                            capture_output=True, text=True, timeout=300)
         assert r.returncode == 0, r.stderr
