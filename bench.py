@@ -49,10 +49,12 @@ LBFGS closure evaluates the latter, so the grad timing is the one the
 training loop feels).  TPU-only; try/except-guarded so a kernel
 regression can never break the headline artifact.
 
-Validation without a TPU: ``FEDTPU_BENCH_FORCE_CPU=1
-FEDTPU_BENCH_MEASURE_ON_CPU=1`` plus the ``FEDTPU_BENCH_{CLIENTS_PER_
-CHIP,BATCH,STEPS,REPS}`` scale knobs run the FULL measurement path at
-toy scale on the CPU backend (numbers meaningless, plumbing real).
+Validation without a TPU: ``FEDTPU_BENCH_FORCE_CPU=1`` and
+``FEDTPU_BENCH_MEASURE_ON_CPU=1`` plus the scale knobs
+``FEDTPU_BENCH_CLIENTS_PER_CHIP`` / ``FEDTPU_BENCH_BATCH`` /
+``FEDTPU_BENCH_STEPS`` / ``FEDTPU_BENCH_REPS`` run the FULL measurement
+path at toy scale on the CPU backend (numbers meaningless, plumbing
+real).
 """
 
 from __future__ import annotations
@@ -94,7 +96,9 @@ def _acquire_backend(attempts: int = 4, probe_timeout: float = 120.0,
     the environment alone; after ``attempts`` failures force the CPU
     backend for this process and return the error string.
 
-    Must run BEFORE the first ``import jax`` in this process.
+    Must run before this process's first DEVICE QUERY: the fallback pins
+    the platform via ``jax.config.update``, which only takes effect if it
+    lands before backend initialization (importing jax earlier is fine).
     """
     if os.environ.get("FEDTPU_BENCH_FORCE_CPU") == "1":
         err = "TPU skipped: FEDTPU_BENCH_FORCE_CPU=1"
@@ -125,11 +129,10 @@ def _acquire_backend(attempts: int = 4, probe_timeout: float = 120.0,
     os.environ["PALLAS_AXON_POOL_IPS"] = ""
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
-    try:
-        jax.config.update("jax_platforms", "cpu")
-    except RuntimeError:
-        pass        # backend already initialized (in-process tests) — those
-        # contexts are already pinned to CPU by their own conftest
+    # silently succeeds even if a backend is already up (jax 0.9: the
+    # update then only governs later re-initialization) — in the
+    # production path nothing has queried devices yet, so it pins CPU
+    jax.config.update("jax_platforms", "cpu")
     return err
 
 

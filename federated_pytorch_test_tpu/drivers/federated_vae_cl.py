@@ -5,8 +5,6 @@ Nloop=12, Nepoch=1, Nadmm=3, lambda2=1e-3, 3-block sweep with per-block
 Adam/LBFGS switching, z written back).
 """
 
-import argparse
-
 from federated_pytorch_test_tpu.data.cifar10 import FederatedCifar10
 from federated_pytorch_test_tpu.drivers import common
 from federated_pytorch_test_tpu.models.vae_cl import AutoEncoderCNNCL

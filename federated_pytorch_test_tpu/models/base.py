@@ -18,7 +18,7 @@ Every model publishes:
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Sequence, Tuple
+from typing import Any, Dict, List
 
 import flax.linen as nn
 import jax

@@ -27,7 +27,7 @@ from typing import Any, List, Optional, Sequence
 import flax.linen as nn
 import jax.numpy as jnp
 
-from federated_pytorch_test_tpu.models.base import BlockModule, elu, pairs
+from federated_pytorch_test_tpu.models.base import BlockModule, elu
 
 
 def _apply_norm(norm: str, name: str, x, train: bool):

@@ -13,9 +13,8 @@ feeds the [K, Niter, nbatch, 32, 32, 8] patch tensor per round.
 
 from __future__ import annotations
 
-import functools
 import time
-from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+from typing import Any, Callable, Dict, List, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp

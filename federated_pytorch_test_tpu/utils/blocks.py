@@ -19,11 +19,11 @@ The mask is Python data (hashable, static under jit), so:
 
 from __future__ import annotations
 
-from typing import Any, List, Mapping, Sequence, Tuple
+from typing import Any, Mapping, Sequence, Tuple
 
 import jax
 
-from federated_pytorch_test_tpu.utils.tree import get_by_path, set_by_path
+from federated_pytorch_test_tpu.utils.tree import set_by_path
 
 
 BlockSpec = Sequence[Tuple[int, int]]  # [(low, high)] inclusive index ranges
