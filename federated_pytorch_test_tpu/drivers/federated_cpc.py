@@ -45,6 +45,8 @@ def main(argv=None):
     p.add_argument("--use-tpu", action=argparse.BooleanOptionalAction,
                    default=True)
     p.add_argument("--checkpoint-dir", default="./checkpoints")
+    p.add_argument("--profile-dir", default=None,
+                   help="write a jax.profiler (XProf) trace of the run")
     args = p.parse_args(argv)
 
     from federated_pytorch_test_tpu.drivers.common import setup_runtime
@@ -71,7 +73,7 @@ def main(argv=None):
                                for k in restored})
         print(f"loaded checkpoint <- {ckpt}")
     state, history = trainer.run(Nloop=args.Nloop, Nadmm=args.Nadmm,
-                                 state=state)
+                                 state=state, profile_dir=args.profile_dir)
     print("Finished Training")
     if args.save_model:
         save_checkpoint(ckpt, state._asdict(), meta={"rounds": len(history)})

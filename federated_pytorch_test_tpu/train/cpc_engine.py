@@ -45,6 +45,7 @@ from federated_pytorch_test_tpu.parallel.mesh import (
 from federated_pytorch_test_tpu.ops.infonce import info_nce_fused
 from federated_pytorch_test_tpu.utils import blocks as blocklib
 from federated_pytorch_test_tpu.utils import codec
+from federated_pytorch_test_tpu.utils.profiling import profile_ctx
 from federated_pytorch_test_tpu.utils.initializers import init_weights
 
 SUBMODELS = ("encoder", "contextgen", "predictor")
@@ -202,8 +203,13 @@ class CPCTrainer:
     # ------------------------------------------------------------------
     def run(self, Nloop: int = 1, Nadmm: int = 1,
             state: Optional[CPCState] = None,
-            log: Callable[[str], None] = print, prefetch: bool = True):
+            log: Callable[[str], None] = print, prefetch: bool = True,
+            profile_dir: Optional[str] = None):
         """The rotation loop (federated_cpc.py:194-304).
+
+        ``profile_dir`` wraps the run in ``jax.profiler.trace``
+        (TensorBoard/XProf format), mirroring the classifier engine's
+        ``--profile-dir`` (SURVEY.md section 5 tracing).
 
         ``prefetch`` (default) double-buffers the host pipeline: a producer
         thread builds round n+1's [K_local, Niter, ...] patch tensor while
@@ -219,6 +225,10 @@ class CPCTrainer:
         ``compute_seconds`` (jitted round, device-synced), plus their sum
         ``round_seconds`` (SURVEY.md section 5 tracing).
         """
+        with profile_ctx(profile_dir):
+            return self._run_impl(Nloop, Nadmm, state, log, prefetch)
+
+    def _run_impl(self, Nloop, Nadmm, state, log, prefetch):
         state = state or self.state0
         history: List[Dict[str, Any]] = []
         csh = client_sharding(self.mesh)

@@ -21,7 +21,6 @@ Nloop sweeps).
 
 from __future__ import annotations
 
-import contextlib
 import functools
 import os
 import time
@@ -58,6 +57,7 @@ from federated_pytorch_test_tpu.train.losses import accuracy_count, cross_entrop
 from federated_pytorch_test_tpu.utils import blocks as blocklib
 from federated_pytorch_test_tpu.utils import codec
 from federated_pytorch_test_tpu.utils.initializers import init_weights
+from federated_pytorch_test_tpu.utils.profiling import profile_ctx
 
 
 class ClientState(NamedTuple):
@@ -629,11 +629,8 @@ class BlockwiseFederatedTrainer:
 
     def _profile_ctx(self):
         """jax.profiler trace over the run when cfg.profile_dir is set
-        (SURVEY.md section 5 tracing; TensorBoard/XProf format)."""
-        if self.cfg.profile_dir:
-            return jax.profiler.trace(
-                os.path.abspath(os.path.expanduser(self.cfg.profile_dir)))
-        return contextlib.nullcontext()
+        (shared helper, utils/profiling.py)."""
+        return profile_ctx(self.cfg.profile_dir)
 
     def run(self, *args, **kw):
         """The full loop nest (see ``_run_impl``), optionally profiled."""

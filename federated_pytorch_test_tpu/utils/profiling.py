@@ -1,0 +1,21 @@
+"""Shared tracing/profiling context (SURVEY.md section 5).
+
+Both engines wrap their run loop in this: a ``jax.profiler.trace``
+(TensorBoard/XProf format) when a directory is given, else a no-op.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Optional
+
+import jax
+
+
+def profile_ctx(profile_dir: Optional[str]):
+    """``jax.profiler.trace(profile_dir)`` or a nullcontext when unset."""
+    if profile_dir:
+        return jax.profiler.trace(
+            os.path.abspath(os.path.expanduser(profile_dir)))
+    return contextlib.nullcontext()

@@ -157,6 +157,19 @@ class TestCPCTrainer:
                    for h in hist)
 
     @pytest.mark.slow
+    def test_profile_trace_written(self, tmp_path):
+        """--profile-dir parity with the classifier engine (SURVEY.md
+        section 5 tracing): the CPC run wraps in jax.profiler.trace."""
+        from federated_pytorch_test_tpu.train.cpc_engine import CPCTrainer
+
+        src = CPCDataSource(["a.h5", "b.h5"], ["0", "1"], batch_size=2)
+        t = CPCTrainer(src, latent_dim=8, reduced_dim=4, Niter=1)
+        t.run(Nloop=1, Nadmm=1, log=lambda m: None,
+              profile_dir=str(tmp_path / "trace"))
+        hits = list((tmp_path / "trace").rglob("*.xplane.pb"))
+        assert hits, "no xplane trace written"
+
+    @pytest.mark.slow
     def test_prefetch_matches_direct_trajectory(self):
         """The (seed, round, client)-keyed draws make the prefetched and
         direct pipelines bit-identical — losses and residuals must agree
