@@ -13,8 +13,8 @@ import os
 from typing import Optional
 
 from federated_pytorch_test_tpu.data.cifar10 import FederatedCifar10
-from federated_pytorch_test_tpu.models.resnet import ResNet18
-from federated_pytorch_test_tpu.models.simple import Net
+from federated_pytorch_test_tpu.models.resnet import ResNet9, ResNet18
+from federated_pytorch_test_tpu.models.simple import Net, Net1, Net2
 from federated_pytorch_test_tpu.train.algorithms import Algorithm
 from federated_pytorch_test_tpu.train.config import FederatedConfig
 from federated_pytorch_test_tpu.train.engine import BlockwiseFederatedTrainer
@@ -40,6 +40,8 @@ def build_parser(defaults: FederatedConfig, prog: str) -> argparse.ArgumentParse
             p.add_argument(arg, choices=("adam", "lbfgs"), default=default)
         elif f.name == "norm":
             p.add_argument(arg, choices=("batch", "group"), default=default)
+        elif f.name == "model":
+            p.add_argument(arg, choices=MODEL_CHOICES, default=default)
         elif default is None:
             conv = _optional_types.get(f.name)
             if conv is None:
@@ -102,13 +104,35 @@ def apply_platform(cfg: FederatedConfig) -> None:
                       "existing platform")
 
 
+# the single model registry: argparse choices and pick_model both derive
+# from it, so the two cannot drift
+_MODELS = {"net": Net, "net1": Net1, "net2": Net2,
+           "resnet9": ResNet9, "resnet18": ResNet18}
+MODEL_CHOICES = ("auto",) + tuple(_MODELS)
+
+
+def pick_model(cfg: FederatedConfig):
+    """Classifier model from cfg.model (the reference's source-edit model
+    switch, federated_multi.py:92-97, as a flag); "auto" keeps the
+    use_resnet semantics."""
+    import jax.numpy as jnp
+
+    dtype = jnp.bfloat16 if cfg.bf16 else None
+    name = cfg.model
+    if name == "auto":
+        name = "resnet18" if cfg.use_resnet else "net"
+    if name not in _MODELS:
+        raise ValueError(f"unknown model {name!r}; "
+                         f"expected one of {MODEL_CHOICES}")
+    if name.startswith("resnet"):
+        return _MODELS[name](dtype=dtype, norm=cfg.norm)
+    return _MODELS[name](dtype=dtype)
+
+
 def make_trainer(cfg: FederatedConfig, algorithm: Algorithm,
                  n_train: Optional[int] = None,
                  n_test: Optional[int] = None) -> BlockwiseFederatedTrainer:
-    import jax.numpy as jnp
-    dtype = jnp.bfloat16 if cfg.bf16 else None
-    model = (ResNet18(dtype=dtype, norm=cfg.norm) if cfg.use_resnet
-             else Net(dtype=dtype))
+    model = pick_model(cfg)
     data = FederatedCifar10(
         K=cfg.K, batch=cfg.default_batch, biased_input=cfg.biased_input,
         drop_last_sample=cfg.drop_last_sample, data_dir=cfg.data_dir,
@@ -162,7 +186,10 @@ def run_classifier_driver(prog: str, defaults: FederatedConfig,
     cfg = config_from_args(args)
     setup_runtime(cfg)
     trainer = make_trainer(cfg, algorithm, args.n_train, args.n_test)
-    print(f"{prog}: K={cfg.K} model={'ResNet18' if cfg.use_resnet else 'Net'} "
+    mname = type(trainer.model).__name__
+    if mname == "ResNet":
+        mname = f"ResNet{trainer.model.qualifier}"
+    print(f"{prog}: K={cfg.K} model={mname} "
           f"devices={trainer.D} clients/device={trainer.K_local} "
           f"data={trainer.data.source}")
     state = maybe_load(trainer, prog)

@@ -60,18 +60,25 @@ class Net1(BlockModule):
     """4 conv (32,32,64,64) + 2 pool + fc 1600→512→10."""
 
     num_classes: int = 10
+    dtype: Any = None  # compute dtype (bf16 on TPU); params & head stay f32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
-        x = elu(nn.Conv(32, (3, 3), padding="VALID", name="conv1")(x))  # 30x30
-        x = elu(nn.Conv(32, (3, 3), padding="VALID", name="conv2")(x))  # 28x28
+        d = self.dtype
+        x = elu(nn.Conv(32, (3, 3), padding="VALID", dtype=d,
+                        name="conv1")(x))  # 30x30
+        x = elu(nn.Conv(32, (3, 3), padding="VALID", dtype=d,
+                        name="conv2")(x))  # 28x28
         x = max_pool_2x2(x)  # 14x14
-        x = elu(nn.Conv(64, (3, 3), padding="VALID", name="conv3")(x))  # 12x12
-        x = elu(nn.Conv(64, (3, 3), padding="VALID", name="conv4")(x))  # 10x10
+        x = elu(nn.Conv(64, (3, 3), padding="VALID", dtype=d,
+                        name="conv3")(x))  # 12x12
+        x = elu(nn.Conv(64, (3, 3), padding="VALID", dtype=d,
+                        name="conv4")(x))  # 10x10
         x = max_pool_2x2(x)  # 5x5
         x = flatten(x)  # 64*5*5 = 1600
-        x = elu(nn.Dense(512, name="fc1")(x))
-        return nn.Dense(self.num_classes, name="fc2")(x)
+        x = elu(nn.Dense(512, dtype=d, name="fc1")(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="fc2")(x.astype(jnp.float32))
 
     def param_order(self) -> List[str]:
         return pairs("conv1", "conv2", "conv3", "conv4", "fc1", "fc2")
@@ -89,19 +96,26 @@ class Net2(BlockModule):
     """4 padded conv (64→512) + 4 pool + 5 fc (2048→128→256→512→1024→10)."""
 
     num_classes: int = 10
+    dtype: Any = None  # compute dtype (bf16 on TPU); params & head stay f32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
-        x = max_pool_2x2(elu(nn.Conv(64, (3, 3), padding="SAME", name="conv1")(x)))  # 16
-        x = max_pool_2x2(elu(nn.Conv(128, (3, 3), padding="SAME", name="conv2")(x)))  # 8
-        x = max_pool_2x2(elu(nn.Conv(256, (3, 3), padding="SAME", name="conv3")(x)))  # 4
-        x = max_pool_2x2(elu(nn.Conv(512, (3, 3), padding="SAME", name="conv4")(x)))  # 2
+        d = self.dtype
+        x = max_pool_2x2(elu(nn.Conv(64, (3, 3), padding="SAME", dtype=d,
+                                     name="conv1")(x)))  # 16
+        x = max_pool_2x2(elu(nn.Conv(128, (3, 3), padding="SAME", dtype=d,
+                                     name="conv2")(x)))  # 8
+        x = max_pool_2x2(elu(nn.Conv(256, (3, 3), padding="SAME", dtype=d,
+                                     name="conv3")(x)))  # 4
+        x = max_pool_2x2(elu(nn.Conv(512, (3, 3), padding="SAME", dtype=d,
+                                     name="conv4")(x)))  # 2
         x = flatten(x)  # 512*2*2 = 2048
-        x = elu(nn.Dense(128, name="fc1")(x))
-        x = elu(nn.Dense(256, name="fc2")(x))
-        x = elu(nn.Dense(512, name="fc3")(x))
-        x = elu(nn.Dense(1024, name="fc4")(x))
-        return nn.Dense(self.num_classes, name="fc5")(x)
+        x = elu(nn.Dense(128, dtype=d, name="fc1")(x))
+        x = elu(nn.Dense(256, dtype=d, name="fc2")(x))
+        x = elu(nn.Dense(512, dtype=d, name="fc3")(x))
+        x = elu(nn.Dense(1024, dtype=d, name="fc4")(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="fc5")(x.astype(jnp.float32))
 
     def param_order(self) -> List[str]:
         return pairs("conv1", "conv2", "conv3", "conv4", "fc1", "fc2", "fc3", "fc4", "fc5")

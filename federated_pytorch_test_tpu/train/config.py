@@ -42,6 +42,11 @@ class FederatedConfig:
     be_verbose: bool = False
     use_resnet: bool = False
     use_tpu: bool = True           # reference `use_cuda` (BASELINE.json rename)
+    # classifier architecture: the reference switches models by editing the
+    # source (uncommenting Net()/Net1()/Net2()/ResNet18(), e.g.
+    # federated_multi.py:92-97); here it is a flag.  "auto" preserves the
+    # use_resnet semantics (resnet18 when set, else net).
+    model: str = "auto"            # auto|net|net1|net2|resnet9|resnet18
     # ResNet normalisation: "batch" = reference parity (per-client running
     # stats); "group" = GroupNorm(32), stat-free and pod-scale safe
     # (models/resnet.py module docstring).  Ignored by the BN-free Net.
