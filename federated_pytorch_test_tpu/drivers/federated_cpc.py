@@ -47,6 +47,8 @@ def main(argv=None):
     p.add_argument("--checkpoint-dir", default="./checkpoints")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler (XProf) trace of the run")
+    p.add_argument("--num-devices", type=int, default=None,
+                   help="mesh size (default: as many devices as divide K)")
     args = p.parse_args(argv)
 
     from federated_pytorch_test_tpu.drivers.common import setup_runtime
@@ -56,7 +58,7 @@ def main(argv=None):
                          batch_size=args.batch_size,
                          patch_size=args.patch_size, seed=args.seed)
     trainer = CPCTrainer(data, latent_dim=args.Lc, reduced_dim=args.Rc,
-                         Niter=args.Niter)
+                         Niter=args.Niter, num_devices=args.num_devices)
     print(f"federated_cpc: K={data.K} Lc={args.Lc} Rc={args.Rc} "
           f"devices={trainer.D}")
     state = trainer.state0

@@ -53,15 +53,12 @@ class TestDriverCLI:
             "--no-check-results"])
         assert all("dual_residual" in h for h in hist)
 
-    def test_model_flag_selects_architecture(self, tmp_path, monkeypatch):
+    def test_model_flag_resolves_every_choice(self):
         """--model replaces the reference's source-edit model switch
-        (federated_multi.py:92-97): every choice must build and train."""
-        monkeypatch.chdir(tmp_path)
+        (federated_multi.py:92-97)."""
         from federated_pytorch_test_tpu.drivers.common import pick_model
-        from federated_pytorch_test_tpu.drivers.federated_multi import main
         from federated_pytorch_test_tpu.train import FederatedConfig
 
-        # cheap resolution check for every choice
         names = {"net": "Net", "net1": "Net1", "net2": "Net2",
                  "resnet9": "ResNet", "resnet18": "ResNet"}
         for choice, cls in names.items():
@@ -70,7 +67,13 @@ class TestDriverCLI:
         assert type(pick_model(FederatedConfig())).__name__ == "Net"
         assert type(pick_model(
             FederatedConfig(use_resnet=True))).__name__ == "ResNet"
-        # one real training smoke on the non-default Net1
+        with pytest.raises(ValueError, match="unknown model"):
+            pick_model(FederatedConfig(model="resnet"))
+
+    @pytest.mark.slow   # full compile+train of a non-default model
+    def test_model_flag_trains_net1(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        from federated_pytorch_test_tpu.drivers.federated_multi import main
         _, hist = main([
             "--K", "2", "--Nloop", "1", "--Nadmm", "1", "--n-train", "32",
             "--n-test", "32", "--default-batch", "16", "--no-save-model",

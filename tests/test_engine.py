@@ -248,6 +248,8 @@ class TestTracing:
 
 
 class TestMeshInvariance:
+    @pytest.mark.slow   # three mesh shapes = three fresh compiles of
+    #                     every block program
     def test_history_invariant_to_device_count(self, data):
         """K=4 clients packed onto 4, 2, or 1 device(s) must train
         identically (up to float reduction order): the vmap-over-local-
