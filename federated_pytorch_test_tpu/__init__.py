@@ -18,6 +18,11 @@ Design (see /root/repo/SURVEY.md section 7):
     solver on flat masked parameter vectors.
 """
 
-__version__ = "0.1.0"
+try:  # single source of truth: pyproject.toml via installed metadata
+    from importlib.metadata import PackageNotFoundError, version
+
+    __version__ = version("federated-pytorch-test-tpu")
+except PackageNotFoundError:  # running from a source checkout
+    __version__ = "0.4.0"
 
 from federated_pytorch_test_tpu.utils import tree as tree_utils  # noqa: F401

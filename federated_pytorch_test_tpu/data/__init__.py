@@ -11,3 +11,8 @@ from federated_pytorch_test_tpu.data.cifar10 import (  # noqa: F401
     FederatedCifar10,
     load_cifar10_arrays,
 )
+from federated_pytorch_test_tpu.data.lofar import (  # noqa: F401
+    CPCDataSource,
+    RoundPrefetcher,
+    get_data_minibatch,
+)

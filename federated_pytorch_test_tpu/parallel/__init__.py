@@ -13,8 +13,14 @@ from federated_pytorch_test_tpu.parallel.mesh import (  # noqa: F401
     CLIENT_AXIS,
     client_mesh,
     client_sharding,
+    fetch,
+    initialize_multihost,
+    local_client_rows,
     replicated_sharding,
     shard_clients,
+    stage_client_rows,
+    stage_global,
+    stage_tree_global,
 )
 from federated_pytorch_test_tpu.parallel.comm import (  # noqa: F401
     all_clients_dot,

@@ -24,10 +24,19 @@ CLIENT_AXIS = "clients"
 
 def client_mesh(num_devices: Optional[int] = None,
                 devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """A 1-D mesh over ``num_devices`` devices with axis ``'clients'``."""
+    """A 1-D mesh over ``num_devices`` devices with axis ``'clients'``.
+
+    An explicit ``num_devices`` must name a satisfiable size: zero,
+    negative, or more-than-available values are user errors and raise
+    (silent clamping/wrapping used to produce confusing downstream
+    divisibility failures)."""
     if devices is None:
         devices = jax.devices()
     if num_devices is not None:
+        if not 1 <= num_devices <= len(devices):
+            raise ValueError(
+                f"num_devices={num_devices} outside [1, {len(devices)}] "
+                "available devices")
         devices = devices[:num_devices]
     return Mesh(np.asarray(devices), (CLIENT_AXIS,))
 

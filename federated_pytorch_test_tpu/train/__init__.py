@@ -14,3 +14,8 @@ from federated_pytorch_test_tpu.train.algorithms import (  # noqa: F401
     NoConsensus,
 )
 from federated_pytorch_test_tpu.train.engine import BlockwiseFederatedTrainer  # noqa: F401
+from federated_pytorch_test_tpu.train.cpc_engine import CPCTrainer  # noqa: F401
+from federated_pytorch_test_tpu.train.vae_engine import (  # noqa: F401
+    VAECLTrainer,
+    VAETrainer,
+)

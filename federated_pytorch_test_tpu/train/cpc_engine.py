@@ -79,7 +79,10 @@ class CPCTrainer:
                               max_iter=lbfgs_max_iter,
                               line_search_fn=True, batch_mode=True)
 
-        mesh = client_mesh(num_devices or usable_device_count(self.K))
+        # `is None`, not `or`: an explicit 0 must reach client_mesh's
+        # validation instead of silently selecting the auto default
+        mesh = client_mesh(usable_device_count(self.K)
+                           if num_devices is None else num_devices)
         self.mesh = mesh
         self.D = mesh.devices.size
         if self.K % self.D:

@@ -124,7 +124,11 @@ class BlockwiseFederatedTrainer:
 
         K = cfg.K
         if mesh is None:
-            mesh = client_mesh(cfg.num_devices or usable_device_count(K))
+            # `is None`, not `or`: an explicit 0 must reach client_mesh's
+            # validation instead of silently selecting the auto default
+            mesh = client_mesh(usable_device_count(K)
+                               if cfg.num_devices is None
+                               else cfg.num_devices)
         self.mesh = mesh
         self.D = mesh.devices.size
         if K % self.D:

@@ -45,6 +45,23 @@ def init_model(model, *args, **kwargs):
     return model.init_variables(jax.random.PRNGKey(0), *args, **kwargs)
 
 
+class TestBf16Compute:
+    def test_simple_models_bf16_compute_keeps_f32_head_and_params(self):
+        """dtype=bfloat16 runs the conv/dense stack on the MXU-friendly
+        dtype while params stay f32 and the logits head computes in f32
+        (numerically stable CE) — same contract as ResNet's knob."""
+        from federated_pytorch_test_tpu.models.simple import Net1, Net2
+
+        for cls in (Net, Net1, Net2):
+            m = cls(dtype=jnp.bfloat16)
+            params, _ = init_model(m, jnp.zeros(CIFAR))
+            assert all(v.dtype == jnp.float32
+                       for _, v in iter_paths(params))
+            out = m.apply({"params": params}, jnp.zeros(CIFAR))
+            assert out.dtype == jnp.float32, cls.__name__
+            assert out.shape == (2, 10)
+
+
 class TestNet:
     def test_forward_shape_and_params(self):
         model = Net()
