@@ -22,7 +22,7 @@ try:  # single source of truth: pyproject.toml via installed metadata
     from importlib.metadata import PackageNotFoundError, version
 
     __version__ = version("federated-pytorch-test-tpu")
-except PackageNotFoundError:  # running from a source checkout
-    __version__ = "0.4.0"
+except PackageNotFoundError:  # uninstalled source checkout: no duplicate
+    __version__ = "0.0.0+uninstalled"  # version literal to keep in sync
 
 from federated_pytorch_test_tpu.utils import tree as tree_utils  # noqa: F401

@@ -50,8 +50,6 @@ class TestBf16Compute:
         """dtype=bfloat16 runs the conv/dense stack on the MXU-friendly
         dtype while params stay f32 and the logits head computes in f32
         (numerically stable CE) — same contract as ResNet's knob."""
-        from federated_pytorch_test_tpu.models.simple import Net1, Net2
-
         for cls in (Net, Net1, Net2):
             m = cls(dtype=jnp.bfloat16)
             params, _ = init_model(m, jnp.zeros(CIFAR))
