@@ -49,6 +49,10 @@ def main(argv=None):
                    help="write a jax.profiler (XProf) trace of the run")
     p.add_argument("--num-devices", type=int, default=None,
                    help="mesh size (default: as many devices as divide K)")
+    p.add_argument("--midrun-checkpoint",
+                   action=argparse.BooleanOptionalAction, default=False,
+                   help="save a resumable checkpoint every comm round; "
+                        "resume with --load-model")
     args = p.parse_args(argv)
 
     from federated_pytorch_test_tpu.drivers.common import setup_runtime
@@ -74,8 +78,12 @@ def main(argv=None):
         state = type(state)(**{k: stage_tree_global(restored[k], csh)
                                for k in restored})
         print(f"loaded checkpoint <- {ckpt}")
+    midrun = (os.path.join(args.checkpoint_dir, "federated_cpc_midrun")
+              if args.midrun_checkpoint else None)
     state, history = trainer.run(Nloop=args.Nloop, Nadmm=args.Nadmm,
-                                 state=state, profile_dir=args.profile_dir)
+                                 state=state, profile_dir=args.profile_dir,
+                                 checkpoint_path=midrun,
+                                 resume=args.load_model and midrun is not None)
     print("Finished Training")
     if args.save_model:
         save_checkpoint(ckpt, state._asdict(), meta={"rounds": len(history)})

@@ -204,15 +204,83 @@ class CPCTrainer:
         return self._fn_cache[key]
 
     # ------------------------------------------------------------------
+    # mid-run checkpoint / resume (same design as the classifier engine,
+    # engine.py: crash-safe slot swap + counters; beyond the reference,
+    # which only restarts from its end-of-run encoder<k>.model files,
+    # federated_cpc.py:126-134)
+    # ------------------------------------------------------------------
+    def _save_midrun(self, path, state: CPCState, z, opt_state, px, py,
+                     nxt, history) -> None:
+        from federated_pytorch_test_tpu.utils.checkpoint import (
+            pack_history,
+            save_checkpoint_swapped,
+        )
+
+        nloop, mdl_i, ci, nadmm = nxt
+        mid_block = nadmm > 0       # z + LBFGS state carry over mid-block
+        tree = dict(state._asdict())
+        if mid_block:
+            tree["z"] = z
+            # flat leaf list: orbax round-trips the LBFGS NamedTuple as a
+            # plain dict, so the structure is rebuilt on restore from a
+            # freshly init'd template (leaf order is deterministic)
+            tree["opt_leaves"] = list(jax.tree.leaves(opt_state))
+        meta = {
+            "nloop": nloop, "mdl_i": mdl_i, "ci": ci, "nadmm": nadmm,
+            "mid_block": int(mid_block), "px": px, "py": py,
+            # the (seed, round, client)-keyed draws make the CONSUMED round
+            # count the entire data-order state.  That is len(history), NOT
+            # the source's live counter: the prefetcher draws ahead of
+            # consumption, so self.data._round overshoots by the in-flight
+            # round(s) (data/lofar.py:round_batches)
+            "data_round": len(history),
+            "history": pack_history(history),
+        }
+        save_checkpoint_swapped(path, tree, meta)
+
+    def _restore_midrun(self, path):
+        from federated_pytorch_test_tpu.utils.checkpoint import (
+            load_checkpoint,
+            restore_leaves,
+            unpack_history,
+        )
+
+        tree, meta = load_checkpoint(path)
+        csh = client_sharding(self.mesh)
+        state = CPCState(**{k: stage_tree_global(tree[k], csh)
+                            for k in SUBMODELS})
+        self.data._round = int(meta["data_round"])
+        mid = bool(meta["mid_block"])
+        z = opt_state = None
+        if mid:
+            mdl, ci = SUBMODELS[int(meta["mdl_i"])], int(meta["ci"])
+            _, init_fn, _ = self._build_round(mdl, ci, int(meta["px"]),
+                                              int(meta["py"]))
+            opt_state = stage_tree_global(
+                restore_leaves(tree["opt_leaves"], init_fn(state)), csh)
+            z = stage_global(np.asarray(tree["z"], np.float32),
+                             replicated_sharding(self.mesh))
+        history = unpack_history(meta["history"])
+        nxt = (int(meta["nloop"]), int(meta["mdl_i"]), int(meta["ci"]),
+               int(meta["nadmm"]), mid)
+        return state, z, opt_state, nxt, history
+
     def run(self, Nloop: int = 1, Nadmm: int = 1,
             state: Optional[CPCState] = None,
             log: Callable[[str], None] = print, prefetch: bool = True,
-            profile_dir: Optional[str] = None):
+            profile_dir: Optional[str] = None,
+            checkpoint_path: Optional[str] = None, resume: bool = False):
         """The rotation loop (federated_cpc.py:194-304).
 
         ``profile_dir`` wraps the run in ``jax.profiler.trace``
         (TensorBoard/XProf format), mirroring the classifier engine's
         ``--profile-dir`` (SURVEY.md section 5 tracing).
+
+        ``checkpoint_path`` saves a resumable mid-run checkpoint after
+        every communication round (sub-model params + z + the persistent
+        per-block LBFGS state + rotation counters + the data-order
+        counter); ``resume=True`` with an existing checkpoint continues at
+        the exact next round with a bit-identical trajectory.
 
         ``prefetch`` (default) double-buffers the host pipeline: a producer
         thread builds round n+1's [K_local, Niter, ...] patch tensor while
@@ -229,24 +297,59 @@ class CPCTrainer:
         ``round_seconds`` (SURVEY.md section 5 tracing).
         """
         with profile_ctx(profile_dir):
-            return self._run_impl(Nloop, Nadmm, state, log, prefetch)
+            return self._run_impl(Nloop, Nadmm, state, log, prefetch,
+                                  checkpoint_path, resume)
 
-    def _run_impl(self, Nloop, Nadmm, state, log, prefetch):
+    def _run_impl(self, Nloop, Nadmm, state, log, prefetch,
+                  checkpoint_path=None, resume=False):
+        from federated_pytorch_test_tpu.utils.checkpoint import newest_slot
+
         state = state or self.state0
         history: List[Dict[str, Any]] = []
         csh = client_sharding(self.mesh)
         rows = local_client_rows(self.mesh, self.K)
-        n_rounds = Nloop * Nadmm * sum(
-            len(m.train_order_block_ids()) for m in self.models.values())
+
+        resume_at = r_z = r_opt = None
+        slot = (newest_slot(checkpoint_path)
+                if resume and checkpoint_path is not None else None)
+        if slot is not None:
+            state, r_z, r_opt, resume_at, history = self._restore_midrun(
+                slot)
+            log(f"resumed mid-run checkpoint {slot} at "
+                f"(nloop, model, block, nadmm)={resume_at[:4]}")
+
+        # size the producer by walking the ACTUAL remaining loop structure
+        # (not total - len(history): a resume under a different
+        # Nloop/Nadmm would mis-size it, and an undersized producer means
+        # the final src.get() blocks forever on a dead queue)
+        n_rounds = 0
+        for nl in range(Nloop):
+            for mi, m in enumerate(SUBMODELS):
+                for c in range(len(self.models[m].train_order_block_ids())):
+                    if resume_at is not None and (nl, mi, c) < resume_at[:3]:
+                        continue
+                    start = (resume_at[3]
+                             if resume_at is not None and resume_at[4]
+                             and (nl, mi, c) == resume_at[:3] else 0)
+                    n_rounds += max(0, Nadmm - start)
         src = (RoundPrefetcher(self.data, self.Niter, n_rounds, clients=rows)
-               if prefetch else None)
+               if prefetch and n_rounds > 0 else None)
         try:
             for nloop in range(Nloop):
-                for mdl in SUBMODELS:
+                for mdl_i, mdl in enumerate(SUBMODELS):
                     blocks = self.models[mdl].train_order_block_ids()
                     for ci in range(len(blocks)):
+                        pos = (nloop, mdl_i, ci)
+                        if resume_at is not None and pos < resume_at[:3]:
+                            continue
                         z = opt_state = None
-                        for nadmm in range(Nadmm):
+                        nadmm_start = 0
+                        if (resume_at is not None and pos == resume_at[:3]
+                                and resume_at[4]):
+                            z, opt_state = r_z, r_opt
+                            nadmm_start = resume_at[3]
+                        resume_at = None
+                        for nadmm in range(nadmm_start, Nadmm):
                             t_round = time.perf_counter()
                             px, py, batch = (
                                 src.get() if src is not None
@@ -274,6 +377,18 @@ class CPCTrainer:
                             rec["compute_seconds"] = t_done - t_staged
                             rec["round_seconds"] = t_done - t_round
                             history.append(rec)
+                            if checkpoint_path is not None:
+                                if nadmm + 1 < Nadmm:
+                                    nxt = (nloop, mdl_i, ci, nadmm + 1)
+                                elif ci + 1 < len(blocks):
+                                    nxt = (nloop, mdl_i, ci + 1, 0)
+                                elif mdl_i + 1 < len(SUBMODELS):
+                                    nxt = (nloop, mdl_i + 1, 0, 0)
+                                else:
+                                    nxt = (nloop + 1, 0, 0, 0)
+                                self._save_midrun(checkpoint_path, state, z,
+                                                  opt_state, px, py, nxt,
+                                                  history)
                             log(f"dual (N={N},loop={nloop},model={mdl},"
                                 f"block={ci},avg={nadmm})="
                                 f"{rec['dual_residual']:e} "

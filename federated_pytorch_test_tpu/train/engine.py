@@ -538,25 +538,15 @@ class BlockwiseFederatedTrainer:
     # ------------------------------------------------------------------
     @staticmethod
     def _midrun_slot(path: str) -> Optional[str]:
-        """The newest valid on-disk checkpoint among the swap slots.
-
-        ``_save_midrun`` writes to ``path.next`` then swaps it into
-        ``path`` (old copy parked at ``path.old``), so a kill at any point
-        leaves at least one complete checkpoint: orbax itself finalizes a
-        save atomically (tmp dir + rename), and the swap only removes the
-        previous copy after the new one is complete.
-        """
-        for cand in (path, path + ".next", path + ".old"):
-            if os.path.isdir(os.path.abspath(os.path.expanduser(cand))):
-                return cand
-        return None
+        from federated_pytorch_test_tpu.utils.checkpoint import newest_slot
+        return newest_slot(path)
 
     def _save_midrun(self, path, state: ClientState, blockvars, nxt,
                      history) -> None:
-        import pickle
-        import shutil
-
-        from federated_pytorch_test_tpu.utils.checkpoint import save_checkpoint
+        from federated_pytorch_test_tpu.utils.checkpoint import (
+            pack_history,
+            save_checkpoint_swapped,
+        )
 
         nloop, ci, nadmm = nxt
         mid_block = nadmm > 0
@@ -575,24 +565,16 @@ class BlockwiseFederatedTrainer:
             # resume replays the exact epoch sequence
             "epochs_staged": self._epochs_staged,
             "keys_staged": self._keys_staged,
-            "history": np.frombuffer(pickle.dumps(history), np.uint8),
+            "history": pack_history(history),
         }
-        # crash-safe swap: never delete the only complete checkpoint while
-        # the replacement is still being written (see _midrun_slot)
-        ab = lambda p: os.path.abspath(os.path.expanduser(p))
-        nxt_path, old_path = path + ".next", path + ".old"
-        shutil.rmtree(ab(nxt_path), ignore_errors=True)
-        save_checkpoint(nxt_path, tree, meta)
-        shutil.rmtree(ab(old_path), ignore_errors=True)
-        if os.path.isdir(ab(path)):
-            os.rename(ab(path), ab(old_path))
-        os.rename(ab(nxt_path), ab(path))
-        shutil.rmtree(ab(old_path), ignore_errors=True)
+        save_checkpoint_swapped(path, tree, meta)
 
     def _restore_midrun(self, path):
-        import pickle
-
-        from federated_pytorch_test_tpu.utils.checkpoint import load_checkpoint
+        from federated_pytorch_test_tpu.utils.checkpoint import (
+            load_checkpoint,
+            restore_leaves,
+            unpack_history,
+        )
 
         tree, meta = load_checkpoint(path)
         csh = client_sharding(self.mesh)
@@ -605,13 +587,8 @@ class BlockwiseFederatedTrainer:
         blockvars = None
         if mid:
             _, _, init_opt = self._build_fns(int(meta["ci"]))
-            template = init_opt(params)
-            leaves = [tree["opt_state_leaves"][k] for k in
-                      sorted(tree["opt_state_leaves"],
-                             key=int)] if isinstance(
-                tree["opt_state_leaves"], dict) else tree["opt_state_leaves"]
-            opt = put_c(jax.tree.unflatten(jax.tree.structure(template),
-                                           leaves))
+            opt = put_c(restore_leaves(tree["opt_state_leaves"],
+                                       init_opt(params)))
             blockvars = (put_r(tree["z"]), put_c(tree["y"]),
                          put_r(tree["rho"]), put_c(tree["x0"]),
                          put_c(tree["yhat0"]))
@@ -627,7 +604,7 @@ class BlockwiseFederatedTrainer:
         # a pending prefetched epoch stays valid across restore IF its
         # counter matches (epochs are pure functions of the counter);
         # _stage_epoch's counter check handles both cases
-        history = pickle.loads(np.asarray(meta["history"], np.uint8).tobytes())
+        history = unpack_history(meta["history"])
         return state, blockvars, (int(meta["nloop"]), int(meta["ci"]),
                                   int(meta["nadmm"]), mid), history
 

@@ -37,6 +37,66 @@ def save_checkpoint(path: str, state, meta: Optional[Dict[str, Any]] = None) -> 
     ckptr.save(_abspath(path), tree, force=True)
 
 
+def newest_slot(path: str) -> Optional[str]:
+    """The newest valid on-disk checkpoint among the swap slots.
+
+    :func:`save_checkpoint_swapped` writes to ``path.next`` then swaps it
+    into ``path`` (old copy parked at ``path.old``), so a kill at any point
+    leaves at least one complete checkpoint: orbax itself finalizes a save
+    atomically (tmp dir + rename), and the swap only removes the previous
+    copy after the new one is complete.
+    """
+    for cand in (path, path + ".next", path + ".old"):
+        if os.path.isdir(_abspath(cand)):
+            return cand
+    return None
+
+
+def save_checkpoint_swapped(path: str, tree,
+                            meta: Optional[Dict[str, Any]] = None) -> None:
+    """Crash-safe :func:`save_checkpoint`: never deletes the only complete
+    checkpoint while the replacement is still being written (see
+    :func:`newest_slot`).  Shared by both engines' mid-run checkpoints."""
+    import shutil
+
+    nxt_path, old_path = path + ".next", path + ".old"
+    shutil.rmtree(_abspath(nxt_path), ignore_errors=True)
+    save_checkpoint(nxt_path, tree, meta)
+    shutil.rmtree(_abspath(old_path), ignore_errors=True)
+    if os.path.isdir(_abspath(path)):
+        os.rename(_abspath(path), _abspath(old_path))
+    os.rename(_abspath(nxt_path), _abspath(path))
+    shutil.rmtree(_abspath(old_path), ignore_errors=True)
+
+
+def pack_history(history) -> np.ndarray:
+    """Host history records -> a uint8 buffer orbax can store as a leaf."""
+    import pickle
+
+    return np.frombuffer(pickle.dumps(history), np.uint8)
+
+
+def unpack_history(buf) -> Any:
+    import pickle
+
+    return pickle.loads(np.asarray(buf, np.uint8).tobytes())
+
+
+def restore_leaves(saved, template):
+    """Rebuild a pytree from orbax-restored flat leaves.
+
+    Orbax round-trips a saved ``list(jax.tree.leaves(x))`` as either a
+    list or a dict keyed by stringified index; ``template`` (a freshly
+    initialised pytree of the same type) supplies the structure.  The
+    single normalisation point for both engines' mid-run optimizer-state
+    restore."""
+    if hasattr(saved, "items"):
+        leaves = [saved[k] for k in sorted(saved, key=int)]
+    else:
+        leaves = list(saved)
+    return jax.tree.unflatten(jax.tree.structure(template), leaves)
+
+
 def load_checkpoint(path: str, like=None) -> Tuple[Any, Dict[str, Any]]:
     """Load a checkpoint saved by :func:`save_checkpoint`.
 
