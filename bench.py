@@ -88,13 +88,17 @@ _STEP_FLOPS_PER_IMAGE = 3 * 2 * 0.56e9
 _PROBE = "import jax; d = jax.devices(); assert d[0].platform == 'tpu', d"
 
 
-def _acquire_backend(attempts: int = 4, probe_timeout: float = 120.0,
-                     backoff: float = 20.0) -> str | None:
+def _acquire_backend(attempts: int = 3, probe_timeout: float = 75.0,
+                     backoff: float = 15.0) -> str | None:
     """Probe the TPU backend in a SUBPROCESS (bounded; the axon relay wedge
     hangs the first in-process device query indefinitely, so an in-process
     try/except cannot implement a retry).  On success return None and leave
     the environment alone; after ``attempts`` failures force the CPU
     backend for this process and return the error string.
+
+    Defaults bound the worst case at ~4.3 min before the artifact falls
+    back to CPU: healthy relay probes connect in ~10-30s, and the caller's
+    own timeout must not expire before the one-line artifact is emitted.
 
     Must run before this process's first DEVICE QUERY: the fallback pins
     the platform via ``jax.config.update``, which only takes effect if it

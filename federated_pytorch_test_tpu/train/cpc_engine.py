@@ -256,8 +256,11 @@ class CPCTrainer:
             mdl, ci = SUBMODELS[int(meta["mdl_i"])], int(meta["ci"])
             _, init_fn, _ = self._build_round(mdl, ci, int(meta["px"]),
                                               int(meta["py"]))
+            # eval_shape: only the template STRUCTURE is needed — skip the
+            # jitted shard_map init compile + device work at restore time
             opt_state = stage_tree_global(
-                restore_leaves(tree["opt_leaves"], init_fn(state)), csh)
+                restore_leaves(tree["opt_leaves"],
+                               jax.eval_shape(init_fn, state)), csh)
             z = stage_global(np.asarray(tree["z"], np.float32),
                              replicated_sharding(self.mesh))
         history = unpack_history(meta["history"])
@@ -334,6 +337,9 @@ class CPCTrainer:
                     n_rounds += max(0, Nadmm - start)
         src = (RoundPrefetcher(self.data, self.Niter, n_rounds, clients=rows)
                if prefetch and n_rounds > 0 else None)
+        if slot is not None and n_rounds == 0:
+            log("resumed a COMPLETED run: no rounds remain at "
+                f"Nloop={Nloop} Nadmm={Nadmm}; returning the saved history")
         try:
             for nloop in range(Nloop):
                 for mdl_i, mdl in enumerate(SUBMODELS):

@@ -536,11 +536,6 @@ class BlockwiseFederatedTrainer:
     # counters + the host shuffle PRNG, so a killed run resumes at the
     # exact round with a bit-identical trajectory.
     # ------------------------------------------------------------------
-    @staticmethod
-    def _midrun_slot(path: str) -> Optional[str]:
-        from federated_pytorch_test_tpu.utils.checkpoint import newest_slot
-        return newest_slot(path)
-
     def _save_midrun(self, path, state: ClientState, blockvars, nxt,
                      history) -> None:
         from federated_pytorch_test_tpu.utils.checkpoint import (
@@ -587,8 +582,10 @@ class BlockwiseFederatedTrainer:
         blockvars = None
         if mid:
             _, _, init_opt = self._build_fns(int(meta["ci"]))
+            # eval_shape: only the template STRUCTURE is needed — skip the
+            # jitted shard_map init compile + device work at restore time
             opt = put_c(restore_leaves(tree["opt_state_leaves"],
-                                       init_opt(params)))
+                                       jax.eval_shape(init_opt, params)))
             blockvars = (put_r(tree["z"]), put_c(tree["y"]),
                          put_r(tree["rho"]), put_c(tree["x0"]),
                          put_c(tree["yhat0"]))
@@ -641,8 +638,10 @@ class BlockwiseFederatedTrainer:
         csh = client_sharding(self.mesh)
         rsh = replicated_sharding(self.mesh)
 
+        from federated_pytorch_test_tpu.utils.checkpoint import newest_slot
+
         resume_at = None
-        slot = (self._midrun_slot(checkpoint_path)
+        slot = (newest_slot(checkpoint_path)
                 if resume and checkpoint_path is not None else None)
         if slot is not None:
             state, r_blockvars, resume_at, history = self._restore_midrun(

@@ -60,6 +60,12 @@ def save_checkpoint_swapped(path: str, tree,
     import shutil
 
     nxt_path, old_path = path + ".next", path + ".old"
+    # if a previous crash left the only complete checkpoint in a secondary
+    # slot, promote it to the primary FIRST — otherwise the rmtree below
+    # would leave zero complete checkpoints until the new save finalizes
+    slot = newest_slot(path)
+    if slot is not None and slot != path:
+        os.rename(_abspath(slot), _abspath(path))
     shutil.rmtree(_abspath(nxt_path), ignore_errors=True)
     save_checkpoint(nxt_path, tree, meta)
     shutil.rmtree(_abspath(old_path), ignore_errors=True)

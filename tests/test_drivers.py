@@ -28,6 +28,37 @@ class TestCheckpoint:
         assert restored["w"].shape == (4, 2)
         np.testing.assert_allclose(np.asarray(restored["w"]), 1.0)
 
+    def test_swapped_save_promotes_a_next_only_survivor(self, tmp_path):
+        """Crash-window regression: a kill between orbax finalizing
+        'ck.next' and the rename leaves ONLY '.next' on disk.  The next
+        swapped save must promote that survivor to the primary slot
+        BEFORE clearing '.next', so a second kill mid-save can never
+        leave zero complete checkpoints."""
+        from federated_pytorch_test_tpu.utils.checkpoint import (
+            newest_slot,
+            save_checkpoint_swapped,
+        )
+
+        ck = str(tmp_path / "ck")
+        save_checkpoint(ck + ".next", {"v": np.asarray(1)})   # crash relic
+        assert newest_slot(ck) == ck + ".next"
+        save_checkpoint_swapped(ck, {"v": np.asarray(2)})
+        assert newest_slot(ck) == ck
+        restored, _ = load_checkpoint(ck)
+        assert int(restored["v"]) == 2
+        assert not os.path.isdir(ck + ".next")
+
+    def test_swapped_save_sequence_keeps_primary_current(self, tmp_path):
+        from federated_pytorch_test_tpu.utils.checkpoint import (
+            save_checkpoint_swapped,
+        )
+
+        ck = str(tmp_path / "ck")
+        for v in (1, 2, 3):
+            save_checkpoint_swapped(ck, {"v": np.asarray(v)})
+        restored, _ = load_checkpoint(ck)
+        assert int(restored["v"]) == 3
+
 
 class TestDriverCLI:
     # stays in the quick loop despite two runs: it is the only CLI coverage
