@@ -7,11 +7,15 @@ a bounded subprocess probe + retry; on genuine unavailability the artifact
 still appears, with an ``"error"`` field and ``value = 0``:
 
   {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N,
-   "stem_block_ips_chip": N, "big_block_ips_chip": N, "big_block_N": N,
-   "no_consensus_ips_chip": N, "mfu": N, "chip": "...",
+   "measured": bool, "stem_block_ips_chip": N, "big_block_ips_chip": N,
+   "big_block_N": N, "no_consensus_ips_chip": N, "mfu": N, "chip": "...",
    "infonce_pallas_us": N, "infonce_xla_us": N, "infonce_speedup": N,
    "infonce_grad_pallas_us": N, "infonce_grad_xla_us": N,
    "infonce_grad_speedup": N}
+
+``"measured"`` is True iff the headline was actually timed on a live
+backend; ``value = 0, measured = false`` is the wedged-relay signature
+(round 4's all-zeros artifact was misreadable as "measured 0").
 
 The reference publishes no quantitative numbers (BASELINE.md); the
 driver-set target is >=5,000 CIFAR10 images/sec/chip for the consensus
@@ -266,6 +270,7 @@ def _measure(out: dict) -> None:
                            with_staging=True)
     out["value"] = round(headline, 1)
     out["vs_baseline"] = round(headline / TARGET, 3)
+    out["measured"] = True
 
     # full-net epoch (the no_consensus driver's path): every parameter
     # trainable and NO consensus penalty, so the executed graph is the
@@ -342,6 +347,10 @@ def main():
         "value": 0.0,
         "unit": "images/sec/chip",
         "vs_baseline": 0.0,
+        # flipped to True the moment the headline is actually measured, so
+        # a relay-wedged all-zeros artifact is self-describing (r04 was
+        # misreadable as "measured 0")
+        "measured": False,
     }
     # probe BEFORE importing jax (the wedge hangs in-process init)
     err = _acquire_backend()

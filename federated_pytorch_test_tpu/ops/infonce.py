@@ -2,7 +2,7 @@
 
 The reference computes the (P x P) normalised inner-product matrix with
 nested Python loops (federated_cpc.py:149-180); the framework's XLA path
-(train/cpc_losses.py) is one matmul + log-softmax.  This module fuses the
+(ops/infonce_core.py) is one matmul + log-softmax.  This module fuses the
 whole per-row pipeline into ONE kernel so the score matrix never leaves
 VMEM:
 
@@ -44,7 +44,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from federated_pytorch_test_tpu.train.cpc_losses import (
+from federated_pytorch_test_tpu.ops.infonce_core import (
     flat_patch_matrix,
     log_p_flat,
     safe_norms,
@@ -149,7 +149,7 @@ def _log_p_pallas(Z: jnp.ndarray, Zhat: jnp.ndarray,
 def _dispatch_log_p(Z: jnp.ndarray, Zhat: jnp.ndarray) -> jnp.ndarray:
     impl = _resolve_impl(_pallas_fits(*_padded_dims(*Z.shape)))
     if impl == "xla":
-        return log_p_flat(Z, Zhat)          # shared core, train/cpc_losses.py
+        return log_p_flat(Z, Zhat)          # shared core, ops/infonce_core.py
     return _log_p_pallas(Z, Zhat, interpret=impl == "pallas_interpret")
 
 
@@ -179,7 +179,7 @@ def _fused_flat_fwd(Z, Zhat):
 
 def _grads_xla(Z, Zhat, log_p, ghat):
     """XLA backward (the fallback path of ``_dispatch_grads``)."""
-    # same zero-norm guard as every forward path (cpc_losses.safe_norms):
+    # same zero-norm guard as every forward path (infonce_core.safe_norms):
     # a guarded column has zz ≡ 0, so the norm-path terms (dzn/dzhn)
     # vanish and only the finite numerator path contributes — no NaNs
     zn = safe_norms(Z)
@@ -216,7 +216,7 @@ def _grad_kernel(P: int, z_ref, zhat_ref, logp_ref, ghat_ref,
     ghat = ghat_ref[0, :]      # [T]          0 on pad rows
     zn = jnp.sqrt(jnp.sum(a * a, axis=0))       # [T]
     zhn = jnp.sqrt(jnp.sum(zh * zh, axis=0))    # [P_pad]
-    zn = jnp.where(zn == 0.0, 1.0, zn)          # cpc_losses.safe_norms
+    zn = jnp.where(zn == 0.0, 1.0, zn)          # infonce_core.safe_norms
     zhn = jnp.where(zhn == 0.0, 1.0, zhn)
     denom = zn[:, None] * zhn[None, :]
     zz = jax.lax.dot_general(

@@ -1,55 +1,18 @@
 """InfoNCE loss for CPC (reference federated_cpc.py:149-180).
 
-The reference builds the (P x P) normalized inner-product matrix with nested
-Python loops over patch positions — O(P^2) separate torch ops.  Here it is
-one matmul + a log-softmax-style reduction: identical math, MXU-shaped.
+The implementation lives in :mod:`federated_pytorch_test_tpu.ops.infonce_core`
+(a leaf module) so the Pallas op (ops/infonce.py) can share it without an
+ops<->train import cycle; this module keeps the historical training-layer
+import path alive.
 """
 
 from __future__ import annotations
 
-import jax.numpy as jnp
-from jax.scipy.special import logsumexp
+from federated_pytorch_test_tpu.ops.infonce_core import (  # noqa: F401
+    flat_patch_matrix,
+    info_nce,
+    log_p_flat,
+    safe_norms,
+)
 
-
-def flat_patch_matrix(z: jnp.ndarray) -> jnp.ndarray:
-    """[B, px, py, R] NHWC -> [B*R, P]: column p stacks (batch x channel)
-    values of patch position p (the reference's zz-matrix layout,
-    federated_cpc.py:149-180)."""
-    B, px, py, R = z.shape
-    return z.transpose(0, 3, 1, 2).reshape(-1, px * py)
-
-
-def safe_norms(Z: jnp.ndarray) -> jnp.ndarray:
-    """Column L2 norms with zero columns mapped to 1.
-
-    The reference divides by the raw norm, so an all-zero patch column
-    (e.g. dead features early in training) yields 0/0 = NaN there
-    (federated_cpc.py:160-166); guarding keeps every dispatch path of the
-    fused op (ops/infonce.py) finite and mutually identical.
-
-    The guard sits INSIDE the sqrt: ``where`` on the squared sum makes the
-    VJP finite too (guarding after ``jnp.linalg.norm`` leaves the norm's
-    x/||x|| backward evaluating 0/0 = NaN at a zero column even though the
-    primal is masked, so autodiff through :func:`log_p_flat` would NaN).
-    """
-    sq = jnp.sum(Z * Z, axis=0)
-    return jnp.sqrt(jnp.where(sq == 0.0, 1.0, sq))
-
-
-def log_p_flat(Z: jnp.ndarray, Zhat: jnp.ndarray) -> jnp.ndarray:
-    """Per-position log softmax-diagonal [P] from flat [D, P] matrices —
-    the single XLA reference core shared by :func:`info_nce` and the
-    Pallas op's fallback/backward (ops/infonce.py)."""
-    zz = (Z.T @ Zhat) / (safe_norms(Z)[:, None] * safe_norms(Zhat)[None, :])
-    return jnp.diag(zz) - logsumexp(zz, axis=1)
-
-
-def info_nce(z: jnp.ndarray, zhat: jnp.ndarray) -> jnp.ndarray:
-    """z, zhat: [B, px, py, R] (NHWC; the reference is [B, C, px, py]).
-
-    zz[i, j] = <Z[:,i], Zhat[:,j]> / (||Z[:,i]|| ||Zhat[:,j]||);
-    positives on the diagonal; loss = -sum_i log(softmax_row_i[i] + 1e-6)
-    (the reference adds 1e-6 inside the log, federated_cpc.py:178).
-    """
-    log_p = log_p_flat(flat_patch_matrix(z), flat_patch_matrix(zhat))
-    return -jnp.sum(jnp.log(jnp.exp(log_p) + 1e-6))
+__all__ = ["flat_patch_matrix", "info_nce", "log_p_flat", "safe_norms"]

@@ -1,0 +1,44 @@
+"""Import-order independence guards.
+
+Round 4 shipped an ops<->train cycle (ops/infonce.py imported
+train/cpc_losses.py, whose package __init__ eagerly imported cpc_engine,
+which imports ops.infonce) that broke any process whose FIRST package
+import was ``federated_pytorch_test_tpu.ops`` — the full suite passed only
+by accident of alphabetical test collection.  These tests import each
+subpackage in a FRESH interpreter so collection order can never mask a
+cycle again.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+import pytest
+
+SUBPACKAGES = [
+    "federated_pytorch_test_tpu",
+    "federated_pytorch_test_tpu.data",
+    "federated_pytorch_test_tpu.drivers",
+    "federated_pytorch_test_tpu.models",
+    "federated_pytorch_test_tpu.ops",
+    "federated_pytorch_test_tpu.ops.infonce",
+    "federated_pytorch_test_tpu.optim",
+    "federated_pytorch_test_tpu.parallel",
+    "federated_pytorch_test_tpu.train",
+    "federated_pytorch_test_tpu.train.cpc_losses",
+    "federated_pytorch_test_tpu.utils",
+]
+
+
+@pytest.mark.parametrize("module", SUBPACKAGES)
+def test_fresh_interpreter_import(module):
+    """Each subpackage must import cleanly as the process's first package
+    import (cycles hide behind whichever module happens to load first)."""
+    r = subprocess.run(
+        [sys.executable, "-c", f"import {module}"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, (
+        f"'import {module}' failed in a fresh interpreter:\n{r.stderr}"
+    )
