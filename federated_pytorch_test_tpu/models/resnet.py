@@ -7,6 +7,10 @@ blocks and federation averaging, exactly as torch's ``net.parameters()``
 includes BN weight/bias; running stats live in the ``batch_stats`` collection,
 stay per-client and are never averaged (matching torch, where buffers are not
 in ``parameters()``; see SURVEY.md section 7 "BatchNorm under federation").
+BN is :class:`MaskedBatchNorm`: identical to flax BatchNorm on full batches,
+and given per-sample pad weights (``sample_weight``) it excludes wrap-pad
+rows from the batch statistics, matching torch BN on the true partial batch
+(reference drop_last=False, federated_multi.py:74-83).
 
 ``norm="group"`` swaps every BatchNorm for a GroupNorm (32 groups) at the
 SAME module name, so the parameter enumeration order, the hand-made block
@@ -25,20 +29,74 @@ from __future__ import annotations
 from typing import Any, List, Optional, Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from federated_pytorch_test_tpu.models.base import BlockModule, elu
 
 
-def _apply_norm(norm: str, name: str, x, train: bool):
+class MaskedBatchNorm(nn.Module):
+    """BatchNorm whose batch statistics can exclude pad rows.
+
+    Same parameter/stat tree as ``nn.BatchNorm`` (params ``scale``/``bias``,
+    batch_stats ``mean``/``var``) and the same algorithm (biased variance,
+    EMA update ``ra = m*ra + (1-m)*batch``) — with ``w`` None this IS flax
+    BatchNorm.  With ``w`` given ([B] pad weights, 0 on the wrap-padded rows
+    of the final partial minibatch, data/cifar10.py), the train-time
+    mean/var are weighted over real rows only, so both the normalisation
+    of real rows and the running-stat update reproduce torch BN on the
+    TRUE partial batch (reference federated_multi.py:74-83 uses
+    drop_last=False, so torch BN never sees pad rows) — closing the one
+    known bit-parity hole for the flagship ResNet18 config (PARITY.md C12).
+    """
+
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x, w=None, use_running_average=False):
+        x = jnp.asarray(x, jnp.float32)
+        C = x.shape[-1]
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((C,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((C,), jnp.float32))
+        scale = self.param("scale", nn.initializers.ones, (C,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (C,), jnp.float32)
+        if use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            axes = tuple(range(x.ndim - 1))
+            if w is None:
+                mean = jnp.mean(x, axes)
+                mean2 = jnp.mean(jnp.square(x), axes)
+            else:
+                wf = w.astype(jnp.float32).reshape(
+                    (-1,) + (1,) * (x.ndim - 1))
+                # rows-that-count x spatial positions per row
+                denom = jnp.sum(wf) * (x[0].size // C)
+                mean = jnp.sum(x * wf, axes) / denom
+                mean2 = jnp.sum(jnp.square(x) * wf, axes) / denom
+            var = mean2 - jnp.square(mean)
+            if not self.is_initializing():
+                m = self.momentum
+                ra_mean.value = m * ra_mean.value + (1 - m) * mean
+                ra_var.value = m * ra_var.value + (1 - m) * var
+        y = (x - mean) * jax.lax.rsqrt(var + self.epsilon)
+        return y * scale + bias
+
+
+def _apply_norm(norm: str, name: str, x, train: bool, w=None):
     """BatchNorm (torch defaults: eps=1e-5, momentum=0.1 -> flax 0.9) or
     GroupNorm(32) under the SAME module name.  Normalisation always
-    computes in float32 — only the convs/dense run in the compute dtype."""
+    computes in float32 — only the convs/dense run in the compute dtype.
+    ``w`` ([B] pad weights) excludes wrap-pad rows from BN batch stats;
+    GroupNorm normalises per-sample, so pad rows can't contaminate it."""
     if norm == "group":
         return nn.GroupNorm(num_groups=32, epsilon=1e-5, dtype=jnp.float32,
                             name=name)(x)
-    return nn.BatchNorm(momentum=0.9, epsilon=1e-5, dtype=jnp.float32,
-                        name=name)(x, use_running_average=not train)
+    return MaskedBatchNorm(momentum=0.9, epsilon=1e-5, name=name)(
+        x, w=w, use_running_average=not train)
 
 
 class BasicBlock(nn.Module):
@@ -54,20 +112,22 @@ class BasicBlock(nn.Module):
     norm: str = "batch"           # "batch" (parity) | "group" (pod-safe)
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, train: bool = True,
+                 sample_weight=None) -> jnp.ndarray:
+        w = sample_weight
         in_planes = x.shape[-1]
         out = nn.Conv(self.planes, (3, 3), strides=(self.stride, self.stride),
                       padding="SAME", use_bias=False, dtype=self.dtype,
                       name="conv1")(x)
-        out = elu(_apply_norm(self.norm, "bn1", out, train))
+        out = elu(_apply_norm(self.norm, "bn1", out, train, w))
         out = nn.Conv(self.planes, (3, 3), padding="SAME", use_bias=False,
                       dtype=self.dtype, name="conv2")(out)
-        out = _apply_norm(self.norm, "bn2", out, train)
+        out = _apply_norm(self.norm, "bn2", out, train, w)
         if self.stride != 1 or in_planes != self.expansion * self.planes:
             sc = nn.Conv(self.expansion * self.planes, (1, 1),
                          strides=(self.stride, self.stride), use_bias=False,
                          dtype=self.dtype, name="shortcut_conv")(x)
-            sc = _apply_norm(self.norm, "shortcut_bn", sc, train)
+            sc = _apply_norm(self.norm, "shortcut_bn", sc, train, w)
         else:
             sc = x
         return elu(out + sc)
@@ -87,23 +147,25 @@ class Bottleneck(nn.Module):
     norm: str = "batch"
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, train: bool = True,
+                 sample_weight=None) -> jnp.ndarray:
+        w = sample_weight
         in_planes = x.shape[-1]
         out = nn.Conv(self.planes, (1, 1), use_bias=False, dtype=self.dtype,
                       name="conv1")(x)
-        out = elu(_apply_norm(self.norm, "bn1", out, train))
+        out = elu(_apply_norm(self.norm, "bn1", out, train, w))
         out = nn.Conv(self.planes, (3, 3), strides=(self.stride, self.stride),
                       padding="SAME", use_bias=False, dtype=self.dtype,
                       name="conv2")(out)
-        out = elu(_apply_norm(self.norm, "bn2", out, train))
+        out = elu(_apply_norm(self.norm, "bn2", out, train, w))
         out = nn.Conv(self.expansion * self.planes, (1, 1), use_bias=False,
                       dtype=self.dtype, name="conv3")(out)
-        out = _apply_norm(self.norm, "bn3", out, train)
+        out = _apply_norm(self.norm, "bn3", out, train, w)
         if self.stride != 1 or in_planes != self.expansion * self.planes:
             sc = nn.Conv(self.expansion * self.planes, (1, 1),
                          strides=(self.stride, self.stride), use_bias=False,
                          dtype=self.dtype, name="shortcut_conv")(x)
-            sc = _apply_norm(self.norm, "shortcut_bn", sc, train)
+            sc = _apply_norm(self.norm, "shortcut_bn", sc, train, w)
         else:
             sc = x
         return elu(out + sc)
@@ -128,10 +190,11 @@ class ResNet(BlockModule):
     norm: str = "batch"
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, train: bool = True,
+                 sample_weight=None) -> jnp.ndarray:
         out = nn.Conv(64, (3, 3), padding="SAME", use_bias=False,
                       dtype=self.dtype, name="conv1")(x)
-        out = elu(_apply_norm(self.norm, "bn1", out, train))
+        out = elu(_apply_norm(self.norm, "bn1", out, train, sample_weight))
         block_cls = Bottleneck if self.bottleneck else BasicBlock
         for stage, (planes, stride, n) in enumerate(
             zip(_STAGE_PLANES, _STAGE_STRIDES, self.num_blocks), start=1
@@ -140,7 +203,9 @@ class ResNet(BlockModule):
             for i, s in enumerate(strides):
                 out = block_cls(planes=planes, stride=s, dtype=self.dtype,
                                 norm=self.norm,
-                                name=f"layer{stage}_{i}")(out, train=train)
+                                name=f"layer{stage}_{i}")(
+                                    out, train=train,
+                                    sample_weight=sample_weight)
         out = nn.avg_pool(out, window_shape=(4, 4), strides=(4, 4))
         out = out.reshape((out.shape[0], -1))
         # head in float32 for numerically stable logits/CE

@@ -228,14 +228,17 @@ class BlockwiseFederatedTrainer:
         VAE/VAE-CL losses and must thread ``wb`` into their weighted loss
         the same way (train/vae_losses.py).
         """
-        logits, new_bs = self._apply_train(p, bs, xb)
+        logits, new_bs = self._apply_train(p, bs, xb, wb)
         return self.loss_fn(logits, yb, wb), new_bs
 
-    def _apply_train(self, p, bs, xb):
+    def _apply_train(self, p, bs, xb, wb=None):
         if self.has_bn:
+            # sample_weight excludes wrap-pad rows from BN batch statistics
+            # (MaskedBatchNorm, models/resnet.py): torch BN only ever sees
+            # the true partial batch (federated_multi.py:74-83)
             out, mut = self.model.apply(
                 {"params": p, "batch_stats": bs}, xb, train=True,
-                mutable=["batch_stats"])
+                sample_weight=wb, mutable=["batch_stats"])
             return out, mut["batch_stats"]
         return self.model.apply({"params": p}, xb, train=True), bs
 
@@ -610,10 +613,36 @@ class BlockwiseFederatedTrainer:
         (shared helper, utils/profiling.py)."""
         return profile_ctx(self.cfg.profile_dir)
 
+    def close(self):
+        """Stop the epoch-staging worker and drop any in-flight prefetch.
+
+        Without this, an aborted run (exception mid-loop, or a caller like
+        bench_block that drives ``_stage_epoch`` directly and never reaches
+        the ``last=True`` suppression) leaves a dataset-sized epoch pinned
+        by the pending future and a non-daemon worker delaying interpreter
+        exit.  Idempotent; mirrors ``RoundPrefetcher.close`` (data/lofar.py).
+        """
+        self._prefetch_epochs = False     # no further submits
+        self._pending = None
+        self._stage_pool.shutdown(wait=False, cancel_futures=True)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:                 # interpreter teardown: best-effort
+            pass
+
     def run(self, *args, **kw):
         """The full loop nest (see ``_run_impl``), optionally profiled."""
-        with self._profile_ctx():
-            return self._run_impl(*args, **kw)
+        try:
+            with self._profile_ctx():
+                return self._run_impl(*args, **kw)
+        except BaseException:
+            # an aborted nest leaves a pending prefetch + live worker; the
+            # trainer is done either way, so release them (close is the
+            # documented terminal state — _stage_epoch stops prefetching)
+            self.close()
+            raise
 
     def _run_impl(
         self,
@@ -686,8 +715,8 @@ class BlockwiseFederatedTrainer:
 
                 for nadmm in range(nadmm_start, cfg.Nadmm):
                     t_round = time.perf_counter()
-                    loss_sum = 0.0
-                    stage_s = 0.0
+                    loss_acc = None       # on-device [K] accumulator: the
+                    stage_s = 0.0         # host fetch happens ONCE per round
                     for nepoch in range(cfg.Nepoch):
                         t_stage = time.perf_counter()
                         xb, yb, wb = self._stage_epoch(
@@ -700,11 +729,13 @@ class BlockwiseFederatedTrainer:
                         state, losses = train_epoch(
                             state, y, self.client_norm, keys,
                             xb, yb, wb, z, rho)
-                        loss_sum += float(np.sum(fetch(losses)))
+                        loss_acc = (losses if loss_acc is None
+                                    else loss_acc + losses)
                         if cfg.be_verbose:
                             # per-client epoch losses (the reference's
                             # be_verbose minibatch prints,
-                            # federated_multi.py:199-200)
+                            # federated_multi.py:199-200) — the only path
+                            # that syncs the host inside the epoch loop
                             log(f"verbose: block={ci} nadmm={nadmm} "
                                 f"epoch={nepoch} client_loss="
                                 + np.array2string(fetch(losses),
@@ -722,11 +753,15 @@ class BlockwiseFederatedTrainer:
                         diag = {k: float(v) for k, v in diag.items()}
                     else:
                         diag = {}
-                    # per-round wall-clock (epochs + collective; the float()
-                    # fetches above force a device sync so this is honest).
-                    # stage_seconds isolates host shuffle + H2D copy — with
-                    # the epoch prefetch it should stay near zero unless
-                    # the host pipeline is the bottleneck
+                    # single host sync per round: the loss fetch depends on
+                    # every epoch in the chain and the diag/rho floats on
+                    # the collective, so round_seconds (taken after both)
+                    # covers the device compute honestly.  stage_seconds
+                    # isolates host shuffle + H2D copy — with the epoch
+                    # prefetch it should stay near zero unless the host
+                    # pipeline is the bottleneck
+                    loss_sum = (float(np.sum(fetch(loss_acc)))
+                                if loss_acc is not None else 0.0)
                     rec = dict(nloop=nloop, block=ci, nadmm=nadmm, N=N,
                                loss=loss_sum, rho=float(rho),
                                round_seconds=time.perf_counter() - t_round,
@@ -761,8 +796,12 @@ class BlockwiseFederatedTrainer:
                         log: Callable[[str], None] = print):
         """`no_consensus` path: whole net trainable, Nepoch epochs, Adam
         re-created every epoch (no_consensus_multi.py:128-166), no comm."""
-        with self._profile_ctx():
-            return self._run_independent_impl(state, log)
+        try:
+            with self._profile_ctx():
+                return self._run_independent_impl(state, log)
+        except BaseException:
+            self.close()
+            raise
 
     def _run_independent_impl(self, state, log):
         cfg = self.cfg

@@ -159,6 +159,82 @@ class TestResNet:
         new = mutated["batch_stats"]["bn1"]["mean"]
         assert not np.allclose(old, new)
 
+    def test_masked_bn_matches_flax_batchnorm_unweighted(self):
+        """With w=None, MaskedBatchNorm IS flax nn.BatchNorm: same output,
+        same running-stat update (the drop-in guarantee for every full
+        minibatch)."""
+        import flax.linen as nn
+
+        from federated_pytorch_test_tpu.models.resnet import MaskedBatchNorm
+
+        x = jax.random.normal(jax.random.PRNGKey(2), (8, 4, 4, 16))
+        m = MaskedBatchNorm(momentum=0.9, epsilon=1e-5)
+        ref = nn.BatchNorm(momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+        v = m.init(jax.random.PRNGKey(0), x)
+        vr = ref.init(jax.random.PRNGKey(0), x, use_running_average=False)
+        out, mut = m.apply(v, x, use_running_average=False,
+                           mutable=["batch_stats"])
+        out_r, mut_r = ref.apply(vr, x, use_running_average=False,
+                                 mutable=["batch_stats"])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(out_r),
+                                   rtol=1e-6, atol=1e-6)
+        for k in ("mean", "var"):
+            np.testing.assert_allclose(
+                np.asarray(mut["batch_stats"][k]),
+                np.asarray(mut_r["batch_stats"][k]), rtol=1e-6, atol=1e-6)
+
+    def test_masked_bn_excludes_pad_rows(self):
+        """Padded batch + 0-weights == torch BN on the TRUE partial batch:
+        real-row outputs and the running-stat update must equal running the
+        unpadded sub-batch through plain BN (PARITY.md C12 deviation
+        closed)."""
+        from federated_pytorch_test_tpu.models.resnet import MaskedBatchNorm
+
+        real, pad = 5, 3
+        x_real = jax.random.normal(jax.random.PRNGKey(3), (real, 4, 4, 16))
+        x_pad = jnp.concatenate(
+            [x_real, 7.0 + jnp.zeros((pad, 4, 4, 16))])    # poison pad rows
+        w = jnp.asarray([1.0] * real + [0.0] * pad)
+        m = MaskedBatchNorm(momentum=0.9, epsilon=1e-5)
+        v = m.init(jax.random.PRNGKey(0), x_real)
+        want, mut_want = m.apply(v, x_real, use_running_average=False,
+                                 mutable=["batch_stats"])
+        got, mut_got = m.apply(v, x_pad, w=w, use_running_average=False,
+                               mutable=["batch_stats"])
+        np.testing.assert_allclose(np.asarray(got[:real]), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+        for k in ("mean", "var"):
+            np.testing.assert_allclose(
+                np.asarray(mut_got["batch_stats"][k]),
+                np.asarray(mut_want["batch_stats"][k]),
+                rtol=1e-5, atol=1e-6)
+
+    def test_resnet_sample_weight_excludes_pad_rows(self):
+        """End-to-end through ResNet9: a wrap-padded batch with pad weights
+        produces the same real-row logits and the same batch_stats update
+        as the true partial batch."""
+        model = ResNet9()
+        real, pad = 3, 2
+        x_real = jax.random.normal(jax.random.PRNGKey(4), (real, 32, 32, 3))
+        x_pad = jnp.concatenate(
+            [x_real, jax.random.normal(jax.random.PRNGKey(5),
+                                       (pad, 32, 32, 3))])
+        w = jnp.asarray([1.0] * real + [0.0] * pad)
+        params, batch_stats = init_model(model, x_real, train=False)
+        want, mut_want = model.apply(
+            {"params": params, "batch_stats": batch_stats}, x_real,
+            train=True, mutable=["batch_stats"])
+        got, mut_got = model.apply(
+            {"params": params, "batch_stats": batch_stats}, x_pad,
+            train=True, sample_weight=w, mutable=["batch_stats"])
+        np.testing.assert_allclose(np.asarray(got[:real]), np.asarray(want),
+                                   rtol=1e-4, atol=1e-5)
+        flat_w = jax.tree.leaves(mut_want["batch_stats"])
+        flat_g = jax.tree.leaves(mut_got["batch_stats"])
+        for a, b in zip(flat_g, flat_w):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
     @pytest.mark.parametrize("factory,n_entries", [(ResNet18, 62),
                                                    (ResNet9, 38)])
     def test_groupnorm_variant_same_order_no_stats(self, factory, n_entries):
