@@ -45,34 +45,98 @@ def newest_slot(path: str) -> Optional[str]:
     leaves at least one complete checkpoint: orbax itself finalizes a save
     atomically (tmp dir + rename), and the swap only removes the previous
     copy after the new one is complete.
+
+    Slots are probed NEWEST-first — the ordering is static, not mtime-based,
+    because the swap protocol fixes the age relation: ``path.next`` only
+    survives a crash that hit after its save completed but before the swap,
+    so when present it is always the newest; ``path.old`` only exists
+    mid-swap and is always the oldest.  (Probing ``path`` first would
+    silently resume a round-stale primary and let the next swap's rmtree
+    delete the newer ``.next``.)
     """
-    for cand in (path, path + ".next", path + ".old"):
+    for cand in (path + ".next", path, path + ".old"):
         if os.path.isdir(_abspath(cand)):
             return cand
     return None
+
+
+def _is_primary() -> bool:
+    return jax.process_index() == 0
+
+
+def _barrier(tag: str) -> None:
+    """Cross-process sync so only process 0 performs slot filesystem
+    surgery while peers wait (no-op single-process)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
+
+def _promote_and_sweep(path: str) -> None:
+    """Pre-save slot surgery (PROCESS 0 ONLY — peers hold at a barrier).
+
+    If a previous crash stranded the newest complete checkpoint in
+    ``path.next`` (save finalized, swap never ran), chain it into the
+    primary using ATOMIC RENAMES ONLY — the only rmtree target is
+    ``path.old``, by protocol always the oldest slot — so no failure mode
+    here can delete the newest data.  Also sweeps orbax tmp dirs stranded
+    by a kill mid-write (nothing else ever removes them); age-gated so a
+    concurrent save's fresh tmp dir is never touched.
+    """
+    import glob
+    import shutil
+    import time
+
+    nxt_path, old_path = path + ".next", path + ".old"
+    if os.path.isdir(_abspath(nxt_path)):
+        if os.path.isdir(_abspath(path)):
+            shutil.rmtree(_abspath(old_path), ignore_errors=True)
+            os.rename(_abspath(path), _abspath(old_path))
+        os.rename(_abspath(nxt_path), _abspath(path))
+    now = time.time()
+    for tmp in glob.glob(glob.escape(_abspath(path))
+                         + "*orbax-checkpoint-tmp*"):
+        try:
+            stale = now - os.path.getmtime(tmp) > 3600.0
+        except OSError:
+            continue                  # vanished underneath us
+        if stale:
+            shutil.rmtree(tmp, ignore_errors=True)
+    if os.path.isdir(_abspath(nxt_path)):
+        # refuse to fall through to a save that would rmtree the slot
+        # holding the newest complete checkpoint
+        raise RuntimeError(
+            f"checkpoint promote failed: {nxt_path} still present")
 
 
 def save_checkpoint_swapped(path: str, tree,
                             meta: Optional[Dict[str, Any]] = None) -> None:
     """Crash-safe :func:`save_checkpoint`: never deletes the only complete
     checkpoint while the replacement is still being written (see
-    :func:`newest_slot`).  Shared by both engines' mid-run checkpoints."""
+    :func:`newest_slot`).  Shared by both engines' mid-run checkpoints.
+
+    Multi-host: the orbax save is a collective (every process calls in),
+    but ALL slot filesystem surgery — crash-recovery promote, stale-tmp
+    sweep, and the final swap — runs on process 0 only, between barriers,
+    so skewed peers can never delete each other's in-flight or
+    freshly-promoted slots.
+    """
     import shutil
 
     nxt_path, old_path = path + ".next", path + ".old"
-    # if a previous crash left the only complete checkpoint in a secondary
-    # slot, promote it to the primary FIRST — otherwise the rmtree below
-    # would leave zero complete checkpoints until the new save finalizes
-    slot = newest_slot(path)
-    if slot is not None and slot != path:
-        os.rename(_abspath(slot), _abspath(path))
-    shutil.rmtree(_abspath(nxt_path), ignore_errors=True)
+    if _is_primary():
+        _promote_and_sweep(path)
+    _barrier("fedtpu:ckpt:pre-save")
     save_checkpoint(nxt_path, tree, meta)
-    shutil.rmtree(_abspath(old_path), ignore_errors=True)
-    if os.path.isdir(_abspath(path)):
-        os.rename(_abspath(path), _abspath(old_path))
-    os.rename(_abspath(nxt_path), _abspath(path))
-    shutil.rmtree(_abspath(old_path), ignore_errors=True)
+    _barrier("fedtpu:ckpt:post-save")
+    if _is_primary():
+        shutil.rmtree(_abspath(old_path), ignore_errors=True)
+        if os.path.isdir(_abspath(path)):
+            os.rename(_abspath(path), _abspath(old_path))
+        os.rename(_abspath(nxt_path), _abspath(path))
+        shutil.rmtree(_abspath(old_path), ignore_errors=True)
+    _barrier("fedtpu:ckpt:swapped")
 
 
 def pack_history(history) -> np.ndarray:

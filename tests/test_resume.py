@@ -130,3 +130,79 @@ def jax_to_np(tree):
 
     flat, _ = jax.tree.flatten_with_path(tree)
     return [(jax.tree_util.keystr(p), np.asarray(v)) for p, v in flat]
+
+
+class TestSlotSwapCrashWindows:
+    """Slot-level crash-window coverage for save_checkpoint_swapped.
+
+    The window: a kill AFTER save to ``path.next`` finalized but BEFORE the
+    swap renamed it into ``path`` leaves the NEWER checkpoint in ``.next``
+    and the round-stale one in ``path``; the probe must prefer ``.next``
+    (when present it is always the newest by protocol) and the next swap
+    must not rmtree it.
+    """
+
+    @staticmethod
+    def _save(path, round_):
+        from federated_pytorch_test_tpu.utils.checkpoint import (
+            save_checkpoint,
+        )
+
+        save_checkpoint(path, {"x": np.float32(round_)},
+                        {"round": round_})
+
+    @staticmethod
+    def _round_of(path):
+        from federated_pytorch_test_tpu.utils.checkpoint import (
+            load_checkpoint,
+        )
+
+        return load_checkpoint(path)[1]["round"]
+
+    def test_newest_slot_prefers_next(self, tmp_path):
+        from federated_pytorch_test_tpu.utils.checkpoint import newest_slot
+
+        ck = str(tmp_path / "ck")
+        self._save(ck, 1)                # stale primary (round 1)
+        self._save(ck + ".next", 2)      # crash-stranded newer save
+        assert newest_slot(ck) == ck + ".next"
+        assert self._round_of(newest_slot(ck)) == 2
+
+    def test_swap_after_crash_keeps_newer(self, tmp_path):
+        from federated_pytorch_test_tpu.utils.checkpoint import (
+            newest_slot,
+            save_checkpoint_swapped,
+        )
+
+        ck = str(tmp_path / "ck")
+        self._save(ck, 1)
+        self._save(ck + ".next", 2)
+        # the resumed run restores round 2 and checkpoints round 3: the
+        # swap must promote .next (round 2) over the stale primary, never
+        # leaving the newest data in a slot its own rmtree then deletes
+        save_checkpoint_swapped(ck, {"x": np.float32(3)}, {"round": 3})
+        assert newest_slot(ck) == ck
+        assert self._round_of(ck) == 3
+
+    def test_swap_sweeps_stranded_orbax_tmp_dirs(self, tmp_path):
+        import os
+        import time
+
+        from federated_pytorch_test_tpu.utils.checkpoint import (
+            save_checkpoint_swapped,
+        )
+
+        ck = str(tmp_path / "ck")
+        stranded = tmp_path / "ck.next.orbax-checkpoint-tmp-12345"
+        stranded.mkdir()
+        (stranded / "partial").write_bytes(b"x")
+        fresh = tmp_path / "ck.next.orbax-checkpoint-tmp-67890"
+        fresh.mkdir()
+        # stranded = provably stale (a crashed earlier run); fresh = could
+        # be a skewed peer's in-flight save on a shared fs — must survive
+        old = time.time() - 7200
+        os.utime(stranded, (old, old))
+        save_checkpoint_swapped(ck, {"x": np.float32(1)}, {"round": 1})
+        assert not stranded.exists()
+        assert fresh.exists()
+        assert self._round_of(ck) == 1
