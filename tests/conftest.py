@@ -8,21 +8,32 @@ exercised for real (SURVEY.md section 4's distributed-test strategy).
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# FEDTPU_TEST_TPU=1 keeps the hardware backend so the TPU-gated tests
+# (e.g. test_ops.py::test_compiled_kernels_on_tpu) run compiled on the real
+# chip; everything else in the suite still passes there or skips.
+_USE_TPU = os.environ.get("FEDTPU_TEST_TPU") == "1"
+
+if not _USE_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
-# The environment may pre-import jax (sitecustomize) with a hardware platform
-# already selected; the env var above is then too late, so force via config.
-jax.config.update("jax_platforms", "cpu")
-assert jax.devices()[0].platform == "cpu", "tests must run on the CPU mesh"
-assert len(jax.devices()) >= 8, (
-    "expected 8 virtual CPU devices; xla_force_host_platform_device_count "
-    "was not honored (jax already initialized its backend?)"
-)
+if not _USE_TPU:
+    # The environment may pre-import jax (sitecustomize) with a hardware
+    # platform already selected; the env var above is then too late, so
+    # force via config.
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.devices()[0].platform == "cpu", \
+        "tests must run on the CPU mesh"
+    assert len(jax.devices()) >= 8, (
+        "expected 8 virtual CPU devices; "
+        "xla_force_host_platform_device_count was not honored "
+        "(jax already initialized its backend?)"
+    )
 
 jax.config.update("jax_default_matmul_precision", "float32")
 
