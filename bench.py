@@ -23,10 +23,14 @@ ResNet18 config (BASELINE.json), so ``vs_baseline`` is value / 5000.
 
 HEADLINE (``value``): sustained throughput of one FULL consensus round on
 the largest ResNet18 partition — Nepoch=1 local epoch + ADMM collective +
-dual update + z write-back, INCLUDING the per-epoch host->device staging
-(shuffle + uint8 copy) a production round pays.  This is what a user of
-the reference's end-to-end loop (federated_multi.py:143-220) experiences.
-Side fields characterise the parts:
+dual update + z write-back, INCLUDING the per-epoch staging a production
+round pays.  With the default device-resident data path (train/engine.py
+``_setup_device_data``: raw uint8 shards live in HBM, each epoch is an
+on-device permutation gather) staging is device-side work; datasets over
+the HBM budget fall back to host shuffle + H2D copy, which this same
+timed region then measures.  This is what a user of the reference's
+end-to-end loop (federated_multi.py:143-220) experiences.  Side fields
+characterise the parts:
 
   * stem_block_ips_chip: local-epoch-only throughput on the stem block
     ci=0 (N=1,856), data staged once — the sliver rounds 1-3 headlined,
@@ -196,9 +200,10 @@ def _measure(out: dict) -> None:
                     with_staging=False):
         """images/sec/chip for block ci's local epoch under ``trainer``'s
         algorithm.  ``with_comm`` adds the comm round (+write-back) per
-        rep; ``with_staging`` pays the per-epoch host->device staging
-        (shuffle + uint8 copy + PRNG keys) inside the timed region — the
-        production round does."""
+        rep; ``with_staging`` pays the per-epoch staging inside the timed
+        region, exactly as a production round does — an on-device
+        permutation gather under the default device-resident data path,
+        or host shuffle + uint8 H2D copy on the fallback."""
         csh = client_sharding(trainer.mesh)
         rsh = replicated_sharding(trainer.mesh)
         # epoch prefetch (the production path) stays on only when staging

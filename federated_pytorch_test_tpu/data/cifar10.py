@@ -248,6 +248,14 @@ class FederatedCifar10:
     def samples_per_client(self) -> int:
         return self._train_x.shape[1]
 
+    def train_shards_raw(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Raw per-client shards ([K, n, 32, 32, 3] u8, [K, n] i32).
+
+        The engine's device-resident staging path puts these in HBM once
+        and builds every epoch's shuffled batches with an on-device
+        permutation gather (train/engine.py `_stage_epoch`)."""
+        return self._train_x, self._train_y
+
     @property
     def means(self) -> np.ndarray:
         """Per-client normalisation means [K, 3] (federated_multi.py:60-71)."""

@@ -30,10 +30,12 @@ def build_parser(defaults: FederatedConfig, prog: str) -> argparse.ArgumentParse
     # converters for Optional[...] fields (default None carries no type)
     _optional_types = {"data_dir": str, "num_devices": int,
                        "profile_dir": str}
+    # tri-state booleans: absent -> None (auto), --flag/--no-flag override
+    _optional_bools = {"device_data"}
     for f in dataclasses.fields(FederatedConfig):
         default = getattr(defaults, f.name)
         arg = "--" + f.name.replace("_", "-")
-        if isinstance(default, bool):
+        if f.name in _optional_bools or isinstance(default, bool):
             p.add_argument(arg, action=argparse.BooleanOptionalAction,
                            default=default)
         elif f.name == "optimizer":

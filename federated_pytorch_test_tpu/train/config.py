@@ -71,6 +71,13 @@ class FederatedConfig:
     # data
     data_dir: Optional[str] = None
     drop_last_sample: bool = True  # reference off-by-one parity
+    # device-resident training data: stage each client's raw uint8 shard
+    # into HBM ONCE and build every epoch's shuffled batches with an
+    # on-device permutation gather — the per-epoch host shuffle + H2D copy
+    # (the dominant cost of a production round when the host link is slow)
+    # disappears from the steady state.  None = auto: on when the training
+    # set fits the HBM budget (FEDTPU_DEVICE_DATA_MB, default 2048).
+    device_data: Optional[bool] = None
 
     # checkpointing
     checkpoint_dir: str = "./checkpoints"

@@ -281,13 +281,16 @@ class TestEpochPrefetch:
     def test_prefetch_matches_direct_trajectory(self, data):
         """Epoch data is a pure function of (cfg.seed, counter), so runs
         with the staging worker thread on and off must be bit-identical
-        (engine._stage_epoch)."""
+        (engine._stage_epoch).  device_data=False pins the HOST staging
+        path — device mode has no worker thread."""
         strip = lambda h: [{k: v for k, v in r.items()
                             if not k.endswith("seconds")} for r in h]
 
         def run(prefetch):
-            t = BlockwiseFederatedTrainer(Net(), small_cfg(Nepoch=2), data,
-                                          AdmmConsensus())
+            t = BlockwiseFederatedTrainer(
+                Net(), small_cfg(Nepoch=2, device_data=False), data,
+                AdmmConsensus())
+            assert t._dev_gather is None
             t._prefetch_epochs = prefetch
             _, hist = t.run(log=lambda m: None)
             return strip(hist)
@@ -303,10 +306,75 @@ class TestEpochPrefetch:
     def test_no_trailing_prefetch_after_run(self, data):
         """The run's final epoch must not queue a never-consumed build
         (its dataset-sized result would stay pinned on the trainer)."""
-        t = BlockwiseFederatedTrainer(Net(), small_cfg(), data,
+        t = BlockwiseFederatedTrainer(Net(),
+                                      small_cfg(device_data=False), data,
                                       AdmmConsensus())
         t.run(log=lambda m: None)
         assert t._pending is None
+
+
+class TestDeviceResidentData:
+    """Device-resident epoch staging (engine._setup_device_data): the raw
+    uint8 shards live in HBM and each epoch is an on-device permutation
+    gather — no per-epoch host shuffle / H2D copy.  Auto-on for small
+    datasets; the host path stays available via device_data=False."""
+
+    @pytest.fixture(scope="class")
+    def rdata(self):
+        # limit 24 with batch 16 -> steps=2 with an 8-row remainder batch
+        return FederatedCifar10(K=K, batch=16, limit_per_client=24,
+                                limit_test=16)
+
+    def test_auto_enables_for_small_data(self, rdata):
+        t = BlockwiseFederatedTrainer(Net(), small_cfg(), rdata,
+                                      AdmmConsensus())
+        assert t._dev_gather is not None
+
+    def test_epoch_covers_shard_with_wrap_pad_and_weights(self, rdata):
+        t = BlockwiseFederatedTrainer(Net(), small_cfg(), rdata,
+                                      AdmmConsensus())
+        xb, yb, wb = t._stage_epoch()
+        assert xb.dtype == jnp.uint8
+        xb, yb, wb = (np.asarray(v) for v in (xb, yb, wb))
+        xt, yt = rdata.train_shards_raw()
+        n = rdata.samples_per_client
+        for ck in range(K):
+            flat_y = yb[ck].reshape(-1)
+            # real rows = a permutation of the client's shard labels
+            assert sorted(flat_y[:n].tolist()) == sorted(yt[ck].tolist())
+            # image rows stay paired with their labels through the gather
+            flat_x = xb[ck].reshape(-1, 32, 32, 3)
+            for r in (0, n // 2, n - 1):
+                hit = (xt[ck] == flat_x[r]).all(axis=(1, 2, 3))
+                assert hit.any() and yt[ck][hit.argmax()] == flat_y[r]
+            # pad rows of the remainder batch carry weight 0
+            assert wb[ck, :-1].all()
+            assert wb[ck, -1, : rdata.remainder].all()
+            assert not wb[ck, -1, rdata.remainder:].any()
+
+    def test_counter_keyed_determinism(self, rdata):
+        def epoch0():
+            t = BlockwiseFederatedTrainer(Net(), small_cfg(), rdata,
+                                          AdmmConsensus())
+            return np.asarray(t._stage_epoch()[1])
+
+        np.testing.assert_array_equal(epoch0(), epoch0())
+
+    def test_trains_equivalently_to_host_staging(self, rdata):
+        """Same engine, same algorithm — the two staging paths draw
+        different permutations (jax vs numpy RNG) but must both train to
+        finite residuals with identical record structure."""
+        hists = {}
+        for dev in (True, False):
+            t = BlockwiseFederatedTrainer(
+                Net(), small_cfg(device_data=dev), rdata, AdmmConsensus())
+            assert (t._dev_gather is not None) == dev
+            _, hist = t.run(log=lambda m: None)
+            hists[dev] = hist
+        assert len(hists[True]) == len(hists[False])
+        for a, b in zip(hists[True], hists[False]):
+            assert a.keys() == b.keys()
+            assert np.isfinite(a["loss"]) and np.isfinite(a["dual_residual"])
 
 
 class TestMultihostHelpers:

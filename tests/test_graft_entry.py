@@ -15,6 +15,7 @@ class TestGraftEntry:
         out = jax.jit(fn)(*args)
         assert out.shape == (8, 10)
 
+    @pytest.mark.slow          # ~75s: compiles four engines + CPC rotation
     def test_dryrun_multichip_8(self):
         import __graft_entry__
         __graft_entry__.dryrun_multichip(8)

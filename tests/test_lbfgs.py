@@ -155,6 +155,7 @@ class TestFullBatchCubicWolfe:
         np.testing.assert_allclose(np.asarray(x), np.asarray(x_star),
                                    atol=1e-2)
 
+    @pytest.mark.slow          # ~35s: 10-iter cubic/zoom compile per step
     def test_rosenbrock_descends(self):
         def rosen(x):
             return 100.0 * (x[1] - x[0] ** 2) ** 2 + (1 - x[0]) ** 2

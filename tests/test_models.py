@@ -254,6 +254,7 @@ class TestResNet:
         out_t = model.apply({"params": params}, jnp.zeros(CIFAR), train=True)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(out_t))
 
+    @pytest.mark.slow          # ~28s: ResNet9 engine compile on XLA:CPU
     def test_groupnorm_trains_under_engine(self):
         """End-to-end: the engine sees has_bn=False and the GN ResNet runs
         a consensus round on the client mesh."""
