@@ -7,8 +7,9 @@ a bounded subprocess probe + retry; on genuine unavailability the artifact
 still appears, with an ``"error"`` field and ``value = 0``:
 
   {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N,
-   "measured": bool, "stem_block_ips_chip": N, "big_block_ips_chip": N,
-   "big_block_N": N, "no_consensus_ips_chip": N, "mfu": N, "chip": "...",
+   "measured": bool, "staging": "device"|"host", "stem_block_ips_chip": N,
+   "big_block_ips_chip": N, "big_block_N": N, "no_consensus_ips_chip": N,
+   "mfu": N, "chip": "...",
    "infonce_pallas_us": N, "infonce_xla_us": N, "infonce_speedup": N,
    "infonce_grad_pallas_us": N, "infonce_grad_xla_us": N,
    "infonce_grad_speedup": N}
@@ -266,6 +267,10 @@ def _measure(out: dict) -> None:
     out["big_block_N"] = sizes[big_ci]
     dev = jax.devices()[0]
     out["chip"] = getattr(dev, "device_kind", str(dev))
+    # which staging path the headline's timed region pays (engine auto:
+    # device-resident when the raw shards fit the HBM budget)
+    out["staging"] = ("device" if trainer._dev_gather is not None
+                      else "host")
 
     out["stem_block_ips_chip"] = round(bench_block(trainer, 0), 1)
     out["big_block_ips_chip"] = round(bench_block(trainer, big_ci), 1)
