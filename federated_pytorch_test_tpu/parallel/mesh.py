@@ -131,9 +131,19 @@ def stage_global(x, sharding: NamedSharding):
 
 def stage_tree_global(tree, sharding: NamedSharding):
     """``stage_global`` over every leaf (host/numpy-coerced first) — the
-    shared checkpoint-restore staging path (engine restore, driver load)."""
-    return jax.tree.map(
-        lambda x: stage_global(np.asarray(x), sharding), tree)
+    shared checkpoint-restore staging path (engine restore, driver load).
+
+    A leaf that is ALREADY a global jax.Array with non-addressable shards
+    (orbax multi-host restore populates shardings from the checkpoint
+    file) cannot be coerced through the host — ``np.asarray`` would try
+    to fetch remote shards — so it is resharded on device instead.
+    """
+    def put(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            return jax.device_put(x, sharding)
+        return stage_global(np.asarray(x), sharding)
+
+    return jax.tree.map(put, tree)
 
 
 def fetch(x):

@@ -67,11 +67,29 @@ trainer = BlockwiseFederatedTrainer(Net(), cfg, data, FedAvg(), mesh=mesh)
 trainer.L = 1
 state, hist = trainer.run(log=lambda m: None)
 rec = hist[0]
+
+# mid-run checkpointing on the 2-process mesh: the orbax save is a
+# collective; ALL slot surgery (promote/sweep/swap) runs on process 0
+# between barriers (utils/checkpoint.py).  Then a resumed run restores
+# the completed history as a no-op.
+ck = os.path.join(sys.argv[4], "mp_ck")
+cfg2 = FederatedConfig(K=K, Nloop=1, Nepoch=1, Nadmm=2, default_batch=8,
+                       check_results=False, admm_rho0=0.1)
+t2 = BlockwiseFederatedTrainer(Net(), cfg2, data, FedAvg(), mesh=mesh)
+t2.L = 1
+_, h2 = t2.run(log=lambda m: None, checkpoint_path=ck)
+t3 = BlockwiseFederatedTrainer(Net(), cfg2, data, FedAvg(), mesh=mesh)
+t3.L = 1
+_, h3 = t3.run(log=lambda m: None, checkpoint_path=ck, resume=True)
+assert len(h2) == 2 and len(h3) == 2, (len(h2), len(h3))
+assert h3[-1]["dual_residual"] == h2[-1]["dual_residual"]
+
 print("RESULT", json.dumps({
     "pid": pid,
     "loss": rec["loss"],
     "dual": rec["dual_residual"],
     "acc": [float(a) for a in rec["accuracy"]],
+    "ck_dual": h2[-1]["dual_residual"],
 }), flush=True)
 """
 
@@ -101,10 +119,13 @@ def test_two_process_mesh_runs_and_agrees(tmp_path):
     logs = [tmp_path / f"worker{i}.log" for i in range(2)]
     procs = []
     try:
+        ckdir = tmp_path / "ck"
+        ckdir.mkdir()
         for i in range(2):
             with open(logs[i], "w") as f:
                 procs.append(subprocess.Popen(
-                    [sys.executable, str(worker), str(i), "2", str(port)],
+                    [sys.executable, str(worker), str(i), "2", str(port),
+                     str(ckdir)],
                     env=env, cwd=REPO, stdout=f, stderr=subprocess.STDOUT))
         for p in procs:
             try:
@@ -133,3 +154,5 @@ def test_two_process_mesh_runs_and_agrees(tmp_path):
     assert a["dual"] == b["dual"]
     np.testing.assert_array_equal(a["acc"], b["acc"])
     assert np.isfinite(a["loss"]) and np.isfinite(a["dual"])
+    # the checkpointed + resumed leg agreed across processes too
+    assert a["ck_dual"] == b["ck_dual"] and np.isfinite(a["ck_dual"])
