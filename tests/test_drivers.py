@@ -119,6 +119,10 @@ class TestDriverCLI:
                              "--admm-rho0", "0.05"])
         assert args.K == 4 and args.Nadmm == 7
         assert args.bb_update is True and args.admm_rho0 == 0.05
+        # tri-state device_data: absent -> None (auto), both overrides work
+        assert args.device_data is None
+        assert p.parse_args(["--device-data"]).device_data is True
+        assert p.parse_args(["--no-device-data"]).device_data is False
 
     @pytest.mark.slow   # two full driver runs; engine-level resume is
     #                     covered fast in tests/test_resume.py

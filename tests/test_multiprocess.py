@@ -84,6 +84,32 @@ _, h3 = t3.run(log=lambda m: None, checkpoint_path=ck, resume=True)
 assert len(h2) == 2 and len(h3) == 2, (len(h2), len(h3))
 assert h3[-1]["dual_residual"] == h2[-1]["dual_residual"]
 
+# MID-BLOCK kill + resume: the round-0 checkpoint has mid_block=True, so
+# the resume restores opt_state_leaves and the ADMM block vars — the
+# restore consumers that exercise stage_tree_global's non-addressable
+# branch hardest — and must continue to the uninterrupted trajectory.
+class Killed(Exception):
+    pass
+
+def bomb(state, rec):
+    if rec["nadmm"] == 0:
+        raise Killed
+
+ck2 = os.path.join(sys.argv[4], "mp_ck2")
+t4 = BlockwiseFederatedTrainer(Net(), cfg2, data, FedAvg(), mesh=mesh)
+t4.L = 1
+try:
+    t4.run(log=lambda m: None, checkpoint_path=ck2, on_round=bomb)
+    raise AssertionError("bomb did not fire")
+except Killed:
+    pass
+t5 = BlockwiseFederatedTrainer(Net(), cfg2, data, FedAvg(), mesh=mesh)
+t5.L = 1
+_, h5 = t5.run(log=lambda m: None, checkpoint_path=ck2, resume=True)
+assert len(h5) == 2, len(h5)
+assert h5[-1]["dual_residual"] == h2[-1]["dual_residual"], \
+    (h5[-1]["dual_residual"], h2[-1]["dual_residual"])
+
 print("RESULT", json.dumps({
     "pid": pid,
     "loss": rec["loss"],
