@@ -55,8 +55,13 @@ which multiplied the pruned stem-block rate by unpruned FLOPs).
 The infonce_* fields time the Pallas-fused CPC loss kernel against its
 XLA path (ops/infonce.py) — forward alone and value_and_grad (the CPC
 LBFGS closure evaluates the latter, so the grad timing is the one the
-training loop feels).  TPU-only; try/except-guarded so a kernel
-regression can never break the headline artifact.
+training loop feels).  The cpc_* fields time one full federated-CPC
+rotation (3 sub-models, every block, LBFGS closures) on synthetic LOFAR
+cubes: ``cpc_rotation_seconds`` (warm) and ``cpc_patches_per_sec_chip``,
+at the reduced dims recorded in ``cpc_config`` (see ``_bench_cpc`` for
+why not reference width).  Both groups are TPU-only and
+try/except-guarded so a workload regression can never break the
+headline artifact.
 
 Validation without a TPU: ``FEDTPU_BENCH_FORCE_CPU=1`` and
 ``FEDTPU_BENCH_MEASURE_ON_CPU=1`` plus the scale knobs
@@ -158,9 +163,13 @@ def _peak_flops(device) -> float:
     return 197e12
 
 
-def _measure(out: dict) -> None:
+def _measure(out: dict, progress=lambda: None) -> None:
     """All measurements; fills ``out`` incrementally so a late failure
-    still leaves the fields measured so far in the artifact."""
+    still leaves the fields measured so far in the artifact.
+    ``progress()`` is called after each completed field group — the
+    --measure child prints the partial dict there, so even a
+    timeout-KILLED attempt (e.g. a pathological relay compile) loses only
+    the group in flight, not the whole attempt."""
     import jax
     import jax.numpy as jnp
 
@@ -273,7 +282,9 @@ def _measure(out: dict) -> None:
                       else "host")
 
     out["stem_block_ips_chip"] = round(bench_block(trainer, 0), 1)
+    progress()
     out["big_block_ips_chip"] = round(bench_block(trainer, big_ci), 1)
+    progress()
 
     # HEADLINE: the full production consensus round on the biggest block,
     # staging included
@@ -282,6 +293,7 @@ def _measure(out: dict) -> None:
     out["value"] = round(headline, 1)
     out["vs_baseline"] = round(headline / TARGET, 3)
     out["measured"] = True
+    progress()
 
     # full-net epoch (the no_consensus driver's path): every parameter
     # trainable and NO consensus penalty, so the executed graph is the
@@ -292,15 +304,71 @@ def _measure(out: dict) -> None:
     full_net = bench_block(trainer_nc, None)
     out["no_consensus_ips_chip"] = round(full_net, 1)
     out["mfu"] = round(full_net * _STEP_FLOPS_PER_IMAGE / _peak_flops(dev), 4)
+    progress()
 
     try:                       # never let the kernel microbench break the
         if jax.default_backend() == "tpu":     # headline artifact
             out.update(_bench_infonce())
+            progress()
     except Exception as e:
         # stderr, not stdout: the artifact stays one JSON line, but a
         # kernel regression is visible instead of reading like a CPU run
         print(f"bench_infonce failed: {type(e).__name__}: {e}",
               file=sys.stderr)
+    try:                       # CPC workload round, same guard discipline
+        if (jax.default_backend() == "tpu"
+                and os.environ.get("FEDTPU_BENCH_CPC") != "0"):
+            out.update(_bench_cpc())
+    except Exception as e:
+        print(f"bench_cpc failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
+
+def _bench_cpc() -> dict:
+    """One full federated-CPC rotation (3 sub-models, every block, K=4
+    clients, LBFGSNew(h=7, m=2), Niter=10 fresh minibatches — the
+    reference loop shape, federated_cpc.py:194-304) on synthetic LOFAR
+    visibility cubes.  Reports wall-clock for the warm rotation (a
+    warm-up rotation pays the compiles) and the patch throughput the
+    LBFGS closures sustain; the artifact records the dims it ran at.
+
+    Runs at Lc=64, batch 32 — NOT the reference's Lc=256/batch 128:
+    at that width the jitted CPC round (LBFGS closure re-evaluations x
+    wide dilated-conv encoder) currently triggers a pathological XLA:TPU
+    compile that exceeds the relay compiler's budget (observed: >20 min,
+    then compiler-host death; round-5 session log).  The reduced dims
+    compile in seconds and exercise the identical graph shape.  Skip
+    entirely with FEDTPU_BENCH_CPC=0."""
+    from federated_pytorch_test_tpu.data.lofar import CPCDataSource
+    from federated_pytorch_test_tpu.train.cpc_engine import CPCTrainer
+
+    Lc, Rc, batch, niter = 64, 16, 32, 10
+    src = CPCDataSource([f"bench{i}.h5" for i in range(4)], ["0"] * 4,
+                        batch_size=batch, patch_size=32)
+    trainer = CPCTrainer(src, latent_dim=Lc, reduced_dim=Rc,
+                         lbfgs_history=7, lbfgs_max_iter=2, Niter=niter,
+                         num_devices=1)
+    # patches per staged minibatch (batch_size * patchx * patchy)
+    px, py, y0 = src.minibatch(0)
+    patches_per_batch = int(y0.shape[0])
+
+    def rotation():
+        t0 = time.perf_counter()
+        _, hist = trainer.run(Nloop=1, Nadmm=1, log=lambda m: None)
+        return time.perf_counter() - t0, hist
+
+    rotation()                       # warm-up: pays the LBFGS compiles
+    dt, hist = rotation()
+    # every (model, block) round runs Niter minibatches on each of the
+    # trainer.K clients; clients run data-parallel across the trainer's
+    # OWN mesh (trainer.D devices), so that is the per-chip divisor
+    patches = len(hist) * niter * trainer.K * patches_per_batch
+    return {
+        "cpc_rotation_seconds": round(dt, 2),
+        "cpc_patches_per_sec_chip": round(patches / dt / trainer.D, 1),
+        "cpc_rounds": len(hist),
+        "cpc_config": f"Lc={Lc},Rc={Rc},batch={batch},Niter={niter}",
+    }
 
 
 def _bench_infonce() -> dict:
@@ -354,10 +422,10 @@ def _bench_infonce() -> dict:
 
 def _measure_child() -> int:
     """``bench.py --measure``: run the measurements in THIS process and
-    print the partial-or-complete field dict as one JSON line (stdout's
-    LAST line — stray library prints land earlier).  The parent keeps
-    artifact-printing duty; a wedge that hangs this process is bounded by
-    the parent's timeout."""
+    print the field dict as a JSON line after every completed group (the
+    parent parses stdout's LAST parsable line, so a timeout-KILL loses
+    only the group in flight).  The parent keeps artifact-printing duty;
+    a wedge that hangs this process is bounded by the parent's timeout."""
     out: dict = {}
     rc = 0
     try:
@@ -366,7 +434,7 @@ def _measure_child() -> int:
         )
 
         enable_persistent_compile_cache()
-        _measure(out)
+        _measure(out, progress=lambda: print(json.dumps(out), flush=True))
     except Exception as e:          # noqa: BLE001 — report partial fields
         out["error"] = f"{type(e).__name__}: {e}"
         rc = 1
@@ -386,6 +454,23 @@ def _run_measurement(out: dict, attempts: Optional[int] = None,
         attempts = int(os.environ.get("FEDTPU_BENCH_MEASURE_ATTEMPTS", 3))
     if timeout is None:
         timeout = float(os.environ.get("FEDTPU_BENCH_MEASURE_TIMEOUT", 1500))
+    def last_json(stdout) -> dict:
+        """The LAST parsable JSON *dict* line of child stdout — the child
+        reprints its partial dict after every field group, so even a
+        killed child yields everything up to the group in flight.  Non-dict
+        parsable lines (stray library prints like a bare number) are
+        skipped, not returned — ``out.update`` needs a mapping."""
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode(errors="replace")
+        for ln in reversed((stdout or "").strip().splitlines()):
+            try:
+                v = json.loads(ln)
+            except ValueError:
+                continue
+            if isinstance(v, dict):
+                return v
+        return {}
+
     last = None
     for attempt in range(attempts):
         if attempt:
@@ -394,16 +479,15 @@ def _run_measurement(out: dict, attempts: Optional[int] = None,
             r = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--measure"],
                 timeout=timeout, capture_output=True, text=True)
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as e:
             last = f"measurement hung >{timeout:.0f}s (relay wedged?)"
             print(f"bench: measure attempt {attempt + 1}/{attempts}: {last}",
                   file=sys.stderr)
+            # salvage the progress lines captured before the kill
+            out.update(last_json(e.stdout))
             continue
         sys.stderr.write(r.stderr)      # child diagnostics stay visible
-        try:
-            child = json.loads(r.stdout.strip().splitlines()[-1])
-        except (IndexError, ValueError):
-            child = {}
+        child = last_json(r.stdout)
         if r.returncode == 0 and child:
             out.update(child)
             return

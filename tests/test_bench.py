@@ -158,3 +158,24 @@ class TestMeasurementRetry:
         bench._run_measurement(out, attempts=2, timeout=1.0)
         assert len(calls) == 2
         assert "hung" in out["error"]
+
+    def test_killed_child_progress_lines_are_salvaged(self, monkeypatch):
+        """The child reprints its partial dict after every field group;
+        a timeout-KILLED attempt (e.g. a pathological relay compile mid
+        group) must still contribute everything up to the kill."""
+        def run(*a, **kw):
+            e = bench.subprocess.TimeoutExpired(cmd="m", timeout=1.0)
+            e.stdout = ('{"stem_block_ips_chip": 9.0}\n'
+                        '{"stem_block_ips_chip": 9.0, "value": 4.0, '
+                        '"measured": true}\n'
+                        '1500\n'                 # stray parsable non-dict
+                        'garbage partial li')
+            raise e
+
+        monkeypatch.setattr(bench.subprocess, "run", run)
+        monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+        out = {"measured": False}
+        bench._run_measurement(out, attempts=1, timeout=1.0)
+        assert out["value"] == 4.0 and out["measured"] is True
+        assert out["stem_block_ips_chip"] == 9.0
+        assert "hung" in out["error"]
