@@ -244,11 +244,12 @@ def _measure(out: dict, progress=lambda: None) -> None:
             else:
                 bx, by, bw, ks = xb, yb, wb, keys
             state, losses = train_epoch(state, y, trainer.client_norm, ks,
-                                        bx, by, bw, z, rho)
+                                        bx, by, bw, z, rho,
+                                        trainer._ones_mask)
             diag = None
             if with_comm:
                 state, z, y, rho, _, _, diag = comm_fns["plain"](
-                    state, z, y, rho, x0, yhat0)
+                    state, z, y, rho, x0, yhat0, trainer._ones_mask)
             return state, z, y, rho, losses, diag
 
         def sync(losses, diag):

@@ -58,6 +58,14 @@ def main(argv=None):
     from federated_pytorch_test_tpu.drivers.common import setup_runtime
 
     setup_runtime(args)                  # duck-typed: needs .use_tpu only
+    if args.use_tpu and args.Lc > 64:
+        import sys
+
+        print(
+            f"federated_cpc: WARNING — Lc={args.Lc} on the TPU backend can "
+            "trigger a pathological XLA compile of the jitted CPC round "
+            "(observed >20 min at Lc=256; README 'Known issues'); Lc<=64 "
+            "compiles in seconds", file=sys.stderr)
     data = CPCDataSource(args.file_list, args.sap_list,
                          batch_size=args.batch_size,
                          patch_size=args.patch_size, seed=args.seed)

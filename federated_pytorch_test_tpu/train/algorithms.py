@@ -28,6 +28,22 @@ from federated_pytorch_test_tpu.parallel.comm import federated_mean, federated_s
 from federated_pytorch_test_tpu.parallel.mesh import CLIENT_AXIS
 
 
+def _active_mean(x: jnp.ndarray, w, K: int) -> jnp.ndarray:
+    """Mean of x [K_local, N] over the ACTIVE clients.
+
+    ``w`` is the per-client participation weight [K_local] (1 active /
+    0 inactive); ``None`` means full participation (reference semantics,
+    every client in every round) and reduces to ``federated_mean``.
+    Partial participation — the FedProx paper's motivating regime, cited
+    but never implemented by the reference (README.md:17,
+    fedprox_multi.py:173) — averages over the sampled subset only.
+    """
+    if w is None:
+        return federated_mean(x, K)
+    n_act = lax.psum(jnp.sum(w), CLIENT_AXIS)
+    return federated_sum(w[:, None] * x) / n_act
+
+
 class Algorithm:
     """Base strategy (also the `no_consensus` strategy: train, never talk)."""
 
@@ -41,9 +57,12 @@ class Algorithm:
         """Extra per-client local-loss term; x is the client's flat block."""
         return jnp.float32(0.0)
 
-    def global_update(self, x, z, y, rho, K: int
+    def global_update(self, x, z, y, rho, K: int, w=None
                       ) -> Tuple[jnp.ndarray, jnp.ndarray, Dict[str, jnp.ndarray]]:
-        """(z_new, y_new, diagnostics) from local stacks x,y [K_local, N]."""
+        """(z_new, y_new, diagnostics) from local stacks x,y [K_local, N].
+
+        ``w`` [K_local]: participation weights for this round (1 active,
+        0 inactive); ``None`` = every client (reference parity)."""
         return z, y, {}
 
 
@@ -58,8 +77,8 @@ class FedAvg(Algorithm):
     writeback = True
     communicates = True
 
-    def global_update(self, x, z, y, rho, K):
-        znew = federated_mean(x, K)                       # z = sum x_k / K
+    def global_update(self, x, z, y, rho, K, w=None):
+        znew = _active_mean(x, w, K)                      # z = sum x_k / K
         dual = jnp.linalg.norm(z - znew) / x.shape[-1]    # ||z-znew|| / N
         return znew, y, {"dual_residual": dual}
 
@@ -78,13 +97,16 @@ class FedProx(Algorithm):
         d = x - z
         return 0.5 * rho * jnp.vdot(d, d)
 
-    def global_update(self, x, z, y, rho, K):
-        znew = federated_mean(x, K)
+    def global_update(self, x, z, y, rho, K, w=None):
+        znew = _active_mean(x, w, K)
         n = x.shape[-1]
         dual = jnp.linalg.norm(z - znew) / n
         # primal = sum_k ||rho (x_k - znew)|| / N  (fedprox_multi.py:228-232)
-        local = jnp.sum(jax.vmap(lambda xa: jnp.linalg.norm(rho * (xa - znew)))(x))
-        primal = lax.psum(local, CLIENT_AXIS) / n
+        # — over the round's participants only under partial participation
+        per = jax.vmap(lambda xa: jnp.linalg.norm(rho * (xa - znew)))(x)
+        if w is not None:
+            per = per * w
+        primal = lax.psum(jnp.sum(per), CLIENT_AXIS) / n
         return znew, y, {"primal_residual": primal, "dual_residual": dual}
 
 
@@ -104,11 +126,16 @@ class AdmmConsensus(Algorithm):
         d = x - z
         return jnp.vdot(y, d) + 0.5 * rho * jnp.vdot(d, d)
 
-    def global_update(self, x, z, y, rho, K):
-        znew = federated_sum(y + rho * x) / (K * rho)      # consensus_multi.py:281-285
+    def global_update(self, x, z, y, rho, K, w=None):
+        # consensus_multi.py:281-285; under partial participation the
+        # average and the dual updates below run over the round's
+        # participants only — inactive y_k stay untouched until sampled
+        znew = _active_mean(y + rho * x, w, K) / rho
         n = x.shape[-1]
         dual = jnp.linalg.norm(z - znew) / n               # :287 (before y update)
         ydelta = rho * (x - znew)                          # :294
+        if w is not None:
+            ydelta = w[:, None] * ydelta
         local = jnp.sum(jax.vmap(jnp.linalg.norm)(ydelta))
         primal = lax.psum(local, CLIENT_AXIS) / n          # :292-297
         return znew, y + ydelta, {"primal_residual": primal, "dual_residual": dual}

@@ -52,6 +52,17 @@ class FederatedConfig:
     # (models/resnet.py module docstring).  Ignored by the BN-free Net.
     norm: str = "batch"
 
+    # partial client participation: each communication round samples every
+    # client independently with this probability (at least one is always
+    # kept); inactive clients neither train nor exchange that round —
+    # params, optimizer state and ADMM duals stay untouched until next
+    # sampled.  1.0 = reference parity (all K clients every round;
+    # partial participation is the FedProx paper's motivating regime,
+    # cited at reference README.md:17 but never implemented there).
+    # Incompatible with bb_update (the BB spectral history assumes every
+    # client moves every round).
+    participation: float = 1.0
+
     # adaptive-ADMM Barzilai-Borwein knobs (consensus_multi.py:41-47)
     bb_update: bool = False
     bb_period_T: int = 2

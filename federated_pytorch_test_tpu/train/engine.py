@@ -133,6 +133,14 @@ class BlockwiseFederatedTrainer:
         self.D = mesh.devices.size
         if K % self.D:
             raise ValueError(f"K={K} not divisible by device count {self.D}")
+        if not 0.0 < cfg.participation <= 1.0:
+            raise ValueError(
+                f"participation={cfg.participation} must be in (0, 1]")
+        if cfg.participation < 1.0 and cfg.bb_update:
+            raise ValueError(
+                "participation < 1 is incompatible with bb_update: the BB "
+                "spectral history (x0/yhat0 deltas) assumes every client "
+                "moves every round (consensus_multi.py:242-278)")
         self.K_local = K // self.D
 
         # --- common init: all K clients start from identical weights
@@ -181,6 +189,10 @@ class BlockwiseFederatedTrainer:
         self.client_norm = stage_global(
             np.asarray(data.norm_stats, np.float32), csh  # [K, 2, 3]
         )
+        # full-participation mask, staged once: the train/comm signatures
+        # take the per-round activity vector unconditionally (uniform
+        # shard_map specs); only cfg.participation < 1 ever varies it
+        self._ones_mask = stage_global(np.ones(K, np.float32), csh)
 
         # device-resident training data (cfg.device_data; None = auto by
         # size): the raw uint8 shards live in HBM and every epoch's
@@ -343,16 +355,36 @@ class BlockwiseFederatedTrainer:
                 step, (p, bs, os), (xb_u8, yb, wb, jnp.arange(steps)))
             return p, bs, os, jnp.sum(losses)
 
+        # partial participation (cfg.participation < 1) is a STATIC mode:
+        # the default full-participation build carries no mask plumbing at
+        # all, so the reference-parity path compiles exactly as before
+        partial = cfg.participation < 1.0
+
+        def _sel(active, new, old):
+            """Per-leaf where(active_k, new, old) over the client axis —
+            inactive clients' state is bit-untouched this round."""
+            pick = lambda a, b: jnp.where(
+                active.reshape((-1,) + (1,) * (a.ndim - 1)) > 0, a, b)
+            return jax.tree.map(pick, new, old)
+
         def epoch_shard(state: ClientState, y, norm, keys, xb_u8, yb, wb, z,
-                        rho):
+                        rho, active):
             p, bs, os, loss = jax.vmap(
                 per_client_epoch,
                 in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0, None, None)
             )(state.params, state.batch_stats, state.opt_state, y, norm, keys,
               xb_u8, yb, wb, z, rho)
-            return ClientState(p, bs, os), loss
+            new = ClientState(p, bs, os)
+            if partial:
+                # inactive clients compute (static shapes on the mesh) but
+                # every result is discarded: params/stats/opt state keep
+                # their pre-round values and their loss reads 0
+                new = ClientState(*_sel(active, tuple(new), tuple(state)))
+                loss = loss * active
+            return new, loss
 
-        def comm_shard(state: ClientState, z, y, rho, x0, yhat0, mode):
+        def comm_shard(state: ClientState, z, y, rho, x0, yhat0, active,
+                       mode):
             x = jax.vmap(lambda p: codec.get_trainable_values(p, order, mask))(
                 state.params
             )
@@ -365,12 +397,19 @@ class BlockwiseFederatedTrainer:
                              cfg.bb_epsilon, cfg.bb_rhomax),
                     self.D,
                 )
-            znew, ynew, diag = algo.global_update(x, z, y, rho, K)
+            znew, ynew, diag = algo.global_update(
+                x, z, y, rho, K, w=active if partial else None)
             params = state.params
             if algo.writeback:
-                params = jax.vmap(
+                wrote = jax.vmap(
                     lambda p: codec.put_trainable_values(p, order, mask, znew)
                 )(params)
+                # partial FedAvg: only the round's participants receive z;
+                # stragglers stay stale until next sampled (standard
+                # partial-participation semantics)
+                params = _sel(active, wrote, params) if partial else wrote
+            if partial:
+                diag["n_active"] = lax.psum(jnp.sum(active), CLIENT_AXIS)
             return ClientState(params, state.batch_stats, state.opt_state), \
                 znew, ynew, rho, x0, yhat0, diag
 
@@ -383,7 +422,7 @@ class BlockwiseFederatedTrainer:
                 epoch_shard,
                 mesh=self.mesh,
                 in_specs=(state_specs, spec_c, spec_c, spec_c, spec_c, spec_c,
-                          spec_c, spec_r, spec_r),
+                          spec_c, spec_r, spec_r, spec_c),
                 out_specs=(state_specs, spec_c),
                 check_vma=False,
             )
@@ -395,9 +434,10 @@ class BlockwiseFederatedTrainer:
                 shard_map(
                     functools.partial(comm_shard, mode=mode),
                     mesh=self.mesh,
-                    in_specs=(state_specs, spec_r, spec_c, spec_r, spec_c, spec_c),
-                    out_specs=(state_specs, spec_r, spec_c, spec_r, spec_c, spec_c,
-                               spec_r),
+                    in_specs=(state_specs, spec_r, spec_c, spec_r, spec_c,
+                              spec_c, spec_c),
+                    out_specs=(state_specs, spec_r, spec_c, spec_r, spec_c,
+                               spec_c, spec_r),
                     check_vma=False,
                 )
             )
@@ -511,6 +551,25 @@ class BlockwiseFederatedTrainer:
         """Host-side (numpy) shuffle + gather for epoch ``counter`` — the
         expensive part of staging, safe to run on the worker thread."""
         return self.data.epoch_batches_raw(self._epoch_seed(counter, 0))
+
+    def _round_mask(self, nloop: int, ci: int, nadmm: int):
+        """[K] f32 activity mask for this communication round.
+
+        Full participation (the default, reference parity) returns the
+        staged ones mask.  Under ``cfg.participation < 1`` every client is
+        sampled independently per round — STATELESSLY keyed on the round
+        coordinates, so a resumed run redraws the identical masks — with
+        at least one participant guaranteed.
+        """
+        if self.cfg.participation >= 1.0:
+            return self._ones_mask
+        rng = np.random.default_rng(
+            [self.cfg.seed, 11, nloop, ci, nadmm])
+        m = (rng.random(self.cfg.K)
+             < self.cfg.participation).astype(np.float32)
+        if not m.any():
+            m[int(rng.integers(self.cfg.K))] = 1.0
+        return stage_global(m, client_sharding(self.mesh))
 
     def _want_device_data(self) -> bool:
         want = self.cfg.device_data
@@ -789,6 +848,7 @@ class BlockwiseFederatedTrainer:
 
                 for nadmm in range(nadmm_start, cfg.Nadmm):
                     t_round = time.perf_counter()
+                    active = self._round_mask(nloop, ci, nadmm)
                     loss_acc = None       # on-device [K] accumulator: the
                     stage_s = 0.0         # host fetch happens ONCE per round
                     for nepoch in range(cfg.Nepoch):
@@ -802,7 +862,7 @@ class BlockwiseFederatedTrainer:
                         stage_s += time.perf_counter() - t_stage
                         state, losses = train_epoch(
                             state, y, self.client_norm, keys,
-                            xb, yb, wb, z, rho)
+                            xb, yb, wb, z, rho, active)
                         loss_acc = (losses if loss_acc is None
                                     else loss_acc + losses)
                         if cfg.be_verbose:
@@ -823,7 +883,7 @@ class BlockwiseFederatedTrainer:
                         else:
                             mode = "plain"
                         state, z, y, rho, x0, yhat0, diag = comm_fns[mode](
-                            state, z, y, rho, x0, yhat0)
+                            state, z, y, rho, x0, yhat0, active)
                         diag = {k: float(v) for k, v in diag.items()}
                     else:
                         diag = {}
@@ -895,7 +955,7 @@ class BlockwiseFederatedTrainer:
             xb, yb, wb = self._stage_epoch(last=epoch == cfg.Nepoch - 1)
             state, losses = train_epoch(state, y, self.client_norm,
                                         self._epoch_keys(), xb, yb, wb, z,
-                                        rho)
+                                        rho, self._ones_mask)
             rec = dict(epoch=epoch, loss=float(np.sum(fetch(losses))),
                        epoch_seconds=time.perf_counter() - t_epoch)
             if cfg.check_results:

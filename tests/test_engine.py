@@ -377,6 +377,132 @@ class TestDeviceResidentData:
             assert np.isfinite(a["loss"]) and np.isfinite(a["dual_residual"])
 
 
+class TestPartialParticipation:
+    """cfg.participation < 1: per-round Bernoulli client sampling — the
+    FedProx paper's motivating regime, cited but never implemented by the
+    reference (README.md:17; SURVEY.md section 5 'partial participation is
+    not implemented').  Inactive clients neither train nor exchange:
+    params/opt state/duals stay bit-untouched until next sampled."""
+
+    def _mask(self, trainer, nloop, ci, nadmm):
+        return np.asarray(jax.device_get(
+            trainer._round_mask(nloop, ci, nadmm)))
+
+    def test_full_participation_uses_ones_and_old_signature_results(
+            self, data):
+        t = BlockwiseFederatedTrainer(Net(), small_cfg(), data,
+                                      AdmmConsensus())
+        assert t._round_mask(0, 0, 0) is t._ones_mask
+
+    def test_mask_is_stateless_and_guarantees_one_active(self, data):
+        cfg = small_cfg(participation=0.25)
+        t = BlockwiseFederatedTrainer(Net(), cfg, data, FedAvg())
+        m1 = self._mask(t, 1, 0, 2)
+        m2 = self._mask(t, 1, 0, 2)
+        np.testing.assert_array_equal(m1, m2)      # resume redraws same
+        masks = [self._mask(t, nl, 0, na)
+                 for nl in range(4) for na in range(4)]
+        assert all(m.sum() >= 1 for m in masks)
+        assert any(m.sum() < K for m in masks)     # sampling really thins
+        # tiny probability: the >=1 guarantee must kick in
+        t2 = BlockwiseFederatedTrainer(
+            Net(), small_cfg(participation=1e-9), data, FedAvg())
+        assert all(self._mask(t2, nl, 0, 0).sum() == 1 for nl in range(6))
+
+    def test_inactive_clients_bit_untouched_fedavg(self, data):
+        cfg = small_cfg(participation=0.5, Nadmm=1, seed=3)
+        t = BlockwiseFederatedTrainer(Net(), cfg, data, FedAvg())
+        t.L = 1                  # exactly one communication round
+        active = self._mask(t, 0, 0, 0)
+        assert 0 < active.sum() < K, "seed must give a mixed round"
+        before = client_param_stacks(t, t.init_state(), 0)
+        seen = {}
+        t.run(log=lambda m: None,
+              on_round=lambda s, r: seen.update(r=r, s=s))
+        after = client_param_stacks(t, seen["s"], 0)
+        for k in range(K):
+            if active[k]:          # participants end the round holding z
+                assert not np.allclose(after[k], before[k])
+            else:                  # stragglers: params bit-identical
+                np.testing.assert_array_equal(after[k], before[k])
+        # all participants share the same z (FedAvg write-back)
+        act = [after[k] for k in range(K) if active[k]]
+        for a in act[1:]:
+            np.testing.assert_array_equal(a, act[0])
+        assert seen["r"]["n_active"] == active.sum()
+
+    def test_admm_duals_only_move_for_participants(self, data):
+        from federated_pytorch_test_tpu.parallel.mesh import (
+            client_sharding, replicated_sharding, stage_global,
+        )
+
+        cfg = small_cfg(participation=0.5, Nadmm=1, seed=3)
+        t = BlockwiseFederatedTrainer(Net(), cfg, data, AdmmConsensus())
+        t.L = 1
+        active = self._mask(t, 0, 0, 0)
+        assert 0 < active.sum() < K
+        # one comm round by hand so y is observable (the run loop keeps it
+        # internal): nonzero duals in, assert straggler rows bit-identical
+        train_epoch, comm_fns, init_opt = t._build_fns(0)
+        N = t.block_size(0)
+        state = t.init_state()
+        state = state._replace(opt_state=init_opt(state.params))
+        rsh, csh = replicated_sharding(t.mesh), client_sharding(t.mesh)
+        z = stage_global(np.zeros(N, np.float32), rsh)
+        y0 = np.linspace(0.5, 1.5, K * N).astype(np.float32).reshape(K, N)
+        y = stage_global(y0, csh)
+        rho = stage_global(np.float32(cfg.admm_rho0), rsh)
+        dummy = stage_global(np.zeros((K, 1), np.float32), csh)
+        amask = t._round_mask(0, 0, 0)
+        xb, yb, wb = t._stage_epoch()
+        state, _ = train_epoch(state, y, t.client_norm, t._epoch_keys(),
+                               xb, yb, wb, z, rho, amask)
+        _, _, y_new, _, _, _, diag = comm_fns["plain"](
+            state, z, y, rho, dummy, dummy, amask)
+        y_new = np.asarray(jax.device_get(y_new))
+        assert float(diag["n_active"]) == active.sum()
+        assert np.isfinite(float(diag["primal_residual"]))
+        for k in range(K):
+            if active[k]:          # participants: y_k += rho (x_k - z)
+                assert not np.array_equal(y_new[k], y0[k])
+            else:                  # stragglers: duals bit-untouched
+                np.testing.assert_array_equal(y_new[k], y0[k])
+
+    def test_active_mean_is_mean_over_participants(self, data):
+        from federated_pytorch_test_tpu.train.algorithms import FedAvg
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh = client_mesh(4)
+        x = np.arange(4 * 3, dtype=np.float32).reshape(4, 3)
+        w = np.asarray([1.0, 0.0, 1.0, 0.0], np.float32)
+        algo = FedAvg()
+
+        def f(x, w, z, y):
+            z2, _, d = algo.global_update(x, z, y, jnp.float32(1.0), 4, w=w)
+            return z2
+
+        z = jnp.zeros(3)
+        y = np.zeros((4, 1), np.float32)
+        got = jax.jit(shard_map(
+            f, mesh=mesh, in_specs=(P("clients"), P("clients"), P(),
+                                    P("clients")),
+            out_specs=P(), check_vma=False))(x, w, z, y)
+        np.testing.assert_allclose(np.asarray(got), x[[0, 2]].mean(axis=0),
+                                   rtol=1e-6)
+
+    def test_bb_update_incompatible(self, data):
+        with pytest.raises(ValueError, match="bb_update"):
+            BlockwiseFederatedTrainer(
+                Net(), small_cfg(participation=0.5, bb_update=True), data,
+                AdmmConsensus())
+
+    def test_participation_range_validated(self, data):
+        with pytest.raises(ValueError, match="participation"):
+            BlockwiseFederatedTrainer(
+                Net(), small_cfg(participation=0.0), data, FedAvg())
+
+
 class TestMultihostHelpers:
     """stage_global / fetch (parallel/mesh.py): single-process they reduce
     to device_put / np.asarray; the multi-process branch's callback slicing
