@@ -1,0 +1,48 @@
+"""AOT-compile (no run) the jitted CPC round at a given width.
+
+Separates compile cost from the heavy LBFGS runtime: builds the same
+round fn CPCTrainer.run uses, lowers it with real-shaped abstract args,
+and times .compile() alone.
+
+Usage: python artifacts/probe_cpc_aot.py <Lc> [batch] [Niter] [mdl] [ci]
+"""
+import sys
+import time
+
+import jax
+import numpy as np
+
+from federated_pytorch_test_tpu.data.lofar import CPCDataSource
+from federated_pytorch_test_tpu.train.cpc_engine import CPCTrainer
+from federated_pytorch_test_tpu.utils.compile_cache import (
+    enable_persistent_compile_cache,
+)
+
+Lc = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+batch = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+niter = int(sys.argv[3]) if len(sys.argv) > 3 else 10
+mdl = sys.argv[4] if len(sys.argv) > 4 else "encoder"
+ci = int(sys.argv[5]) if len(sys.argv) > 5 else 0
+
+enable_persistent_compile_cache()
+src = CPCDataSource([f"bench{i}.h5" for i in range(4)], ["0"] * 4,
+                    batch_size=batch, patch_size=32)
+trainer = CPCTrainer(src, latent_dim=Lc, reduced_dim=32,
+                     lbfgs_history=7, lbfgs_max_iter=2, Niter=niter,
+                     num_devices=1)
+px, py, data = src.round_batches(niter)
+print(f"data shape {data.shape} px={px} py={py}", flush=True)
+
+fn, init_fn, N = trainer._build_round(mdl, ci, px, py)
+state = trainer.state0
+
+t0 = time.perf_counter()
+opt_shape = jax.eval_shape(init_fn, state)
+z = jax.ShapeDtypeStruct((N,), np.float32)
+lowered = fn.lower(state, z, opt_shape, jax.ShapeDtypeStruct(
+    data.shape, np.float32))
+print(f"lowered in {time.perf_counter() - t0:.1f}s", flush=True)
+t0 = time.perf_counter()
+lowered.compile()
+print(f"COMPILED {mdl}/{ci} Lc={Lc} B={batch} Niter={niter} N={N} "
+      f"in {time.perf_counter() - t0:.1f}s", flush=True)

@@ -1,0 +1,35 @@
+"""Compile+run ONE full CPC rotation at the given width on the live
+backend, printing per-round progress so a pathological compile is
+attributable to a specific (model, block) round.
+
+Usage: python artifacts/probe_cpc_round.py <Lc> [batch] [Niter]
+"""
+import sys
+import time
+
+from federated_pytorch_test_tpu.data.lofar import CPCDataSource
+from federated_pytorch_test_tpu.train.cpc_engine import CPCTrainer
+from federated_pytorch_test_tpu.utils.compile_cache import (
+    enable_persistent_compile_cache,
+)
+
+Lc = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+batch = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+niter = int(sys.argv[3]) if len(sys.argv) > 3 else 10
+
+enable_persistent_compile_cache()
+src = CPCDataSource([f"bench{i}.h5" for i in range(4)], ["0"] * 4,
+                    batch_size=batch, patch_size=32)
+trainer = CPCTrainer(src, latent_dim=Lc, reduced_dim=32,
+                     lbfgs_history=7, lbfgs_max_iter=2, Niter=niter,
+                     num_devices=1)
+t0 = time.perf_counter()
+
+
+def log(m):
+    print(f"[{time.perf_counter() - t0:7.1f}s] {m}", flush=True)
+
+
+_, hist = trainer.run(Nloop=1, Nadmm=1, log=log)
+print(f"DONE rotation Lc={Lc} B={batch}: {time.perf_counter() - t0:.1f}s "
+      f"({len(hist)} rounds)", flush=True)

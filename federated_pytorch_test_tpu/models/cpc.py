@@ -18,6 +18,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from federated_pytorch_test_tpu.models.base import BlockModule, elu, pairs
+from federated_pytorch_test_tpu.ops.dilated_conv import TapConv
 
 
 def _pad(p: int):
@@ -30,10 +31,17 @@ class EncoderCNN(BlockModule):
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
         """x: [B, 32, 32, 8] → [B, latent_dim]."""
-        # five dilated views, all 32x32 -> 16x16
+        # five dilated views, all 32x32 -> 16x16.  TapConv (im2col) rather
+        # than nn.Conv: at dilation 16 the receptive span (49 px) exceeds
+        # the 32 px input and XLA:TPU's dilated-conv lowering has been
+        # observed to compile pathologically at reference width inside the
+        # jitted CPC round (README "Known issues"); the tap-gather matmul
+        # is numerically identical (tests/test_dilated_conv.py) with the
+        # same param tree.
         xs = []
         for d, p in ((1, 1), (2, 3), (4, 6), (8, 12), (16, 24)):
-            xs.append(elu(nn.Conv(8, (4, 4), strides=(2, 2), kernel_dilation=(d, d),
+            xs.append(elu(TapConv(8, (4, 4), strides=(2, 2),
+                                  kernel_dilation=(d, d),
                                   padding=_pad(p), name=f"conv1_{d}")(x)))
         x = jnp.concatenate(xs, axis=-1)  # [B,16,16,40]
         x = elu(nn.Conv(self.latent_dim // 4, (4, 4), strides=(2, 2),

@@ -1,0 +1,101 @@
+"""Tap-gather lowering for small-input dilated convolutions.
+
+The CPC encoder's stem (reference simple_models.py:441-460) runs five
+parallel 4x4 convs with kernel dilation up to 16 on a 32x32 patch.  At
+dilation 16 the effective receptive span is 1 + 3*16 = 49 px — wider
+than the input — so XLA:TPU's conv lowering (space-to-batch style) pads
+the operand far beyond its payload and has been observed to compile
+pathologically inside the jitted CPC round at reference width
+(README.md "Known issues").
+
+For these shapes the convolution is cheaper to state directly as im2col:
+the k*k dilated taps of the (padded) input are strided slices, and the
+conv is ONE [B*Oh*Ow, k*k*Ci] x [k*k*Ci, Co] matmul — a shape the MXU
+handles natively with nothing for the compiler to get clever about.
+This module provides
+
+  * :func:`dilated_conv_taps` — functional NHWC conv, numerically
+    equivalent to ``lax.conv_general_dilated`` with ``rhs_dilation``
+    (same accumulation order per output element, f32);
+  * :class:`TapConv` — a flax module exposing the SAME param tree as
+    ``nn.Conv`` (``kernel`` [kh,kw,ci,co], ``bias`` [co]) so swapping it
+    into a model changes neither checkpoints nor the flat codec.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def dilated_conv_taps(x: jnp.ndarray, kernel: jnp.ndarray,
+                      bias: Optional[jnp.ndarray] = None, *,
+                      strides: Tuple[int, int] = (1, 1),
+                      dilation: Tuple[int, int] = (1, 1),
+                      padding: Sequence[Tuple[int, int]] = ((0, 0), (0, 0)),
+                      ) -> jnp.ndarray:
+    """NHWC convolution with kernel (rhs) dilation via tap gather + matmul.
+
+    Equivalent to ``lax.conv_general_dilated(x, kernel,
+    window_strides=strides, padding=padding, rhs_dilation=dilation)``
+    with NHWC/HWIO/NHWC dimension numbers.
+
+    x: [B, H, W, Ci]; kernel: [kh, kw, Ci, Co]; bias: [Co] or None.
+    """
+    kh, kw, ci, co = kernel.shape
+    (pt, pb), (pl, pr) = padding
+    sh, sw = strides
+    dh, dw = dilation
+    xp = jnp.pad(x, ((0, 0), (pt, pb), (pl, pr), (0, 0)))
+    span_h = (kh - 1) * dh + 1
+    span_w = (kw - 1) * dw + 1
+    oh = (xp.shape[1] - span_h) // sh + 1
+    ow = (xp.shape[2] - span_w) // sw + 1
+    # taps in (ky, kx) row-major order to match kernel.reshape's
+    # (kh, kw, ci) row-major flattening
+    taps = [
+        xp[:, ky * dh: ky * dh + sh * (oh - 1) + 1: sh,
+           kx * dw: kx * dw + sw * (ow - 1) + 1: sw, :]
+        for ky in range(kh) for kx in range(kw)
+    ]
+    xcol = jnp.concatenate(taps, axis=-1)          # [B, oh, ow, kh*kw*ci]
+    w = kernel.reshape(kh * kw * ci, co)
+    y = jnp.einsum("bhwc,cf->bhwf", xcol, w,
+                   preferred_element_type=x.dtype)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+class TapConv(nn.Module):
+    """Drop-in for ``nn.Conv`` (NHWC, explicit padding) lowered via
+    :func:`dilated_conv_taps`.  Param tree matches ``nn.Conv`` exactly:
+    ``kernel`` [kh, kw, Ci, features] (lecun_normal), ``bias``
+    [features] (zeros, present iff ``use_bias``)."""
+
+    features: int
+    kernel_size: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    kernel_dilation: Tuple[int, int] = (1, 1)
+    padding: Sequence[Tuple[int, int]] = ((0, 0), (0, 0))
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        kh, kw = self.kernel_size
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (kh, kw, x.shape[-1], self.features), jnp.float32)
+        bias = (self.param("bias", nn.initializers.zeros,
+                           (self.features,), jnp.float32)
+                if self.use_bias else None)
+        # nn.Conv semantics (dtype=None): promote operands to a common
+        # dtype rather than downcasting params to x.dtype
+        x, kernel, bias = nn.dtypes.promote_dtype(x, kernel, bias,
+                                                  dtype=None)
+        return dilated_conv_taps(
+            x, kernel, bias,
+            strides=self.strides, dilation=self.kernel_dilation,
+            padding=self.padding)

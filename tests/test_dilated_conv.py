@@ -1,0 +1,95 @@
+"""ops/dilated_conv: tap-gather lowering vs nn.Conv ground truth.
+
+The TapConv module must be a bit-for-bit drop-in for nn.Conv with
+kernel_dilation (same param tree, numerically matching output) because
+the CPC encoder swaps it in for the dilated stem at any width
+(models/cpc.py, replacing reference simple_models.py:441-460).
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from federated_pytorch_test_tpu.ops.dilated_conv import (
+    TapConv,
+    dilated_conv_taps,
+)
+
+# the five stem configurations (dilation, padding) from the reference
+# encoder plus a stride-1 no-dilation smoke case
+STEM_CASES = [(1, 1), (2, 3), (4, 6), (8, 12), (16, 24)]
+
+
+def _ref_conv(x, kernel, bias, strides, dilation, padding):
+    dn = jax.lax.conv_dimension_numbers(x.shape, kernel.shape,
+                                        ("NHWC", "HWIO", "NHWC"))
+    y = jax.lax.conv_general_dilated(
+        x, kernel, window_strides=strides, padding=padding,
+        rhs_dilation=dilation, dimension_numbers=dn)
+    return y if bias is None else y + bias
+
+
+@pytest.mark.parametrize("dilation,pad", STEM_CASES)
+def test_taps_match_lax_conv(dilation, pad):
+    rng = np.random.default_rng(dilation)
+    x = jnp.asarray(rng.normal(size=(3, 32, 32, 8)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(4, 4, 8, 8)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(8,)), jnp.float32)
+    got = dilated_conv_taps(x, k, b, strides=(2, 2),
+                            dilation=(dilation, dilation),
+                            padding=((pad, pad), (pad, pad)))
+    want = _ref_conv(x, k, b, (2, 2), (dilation, dilation),
+                     ((pad, pad), (pad, pad)))
+    assert got.shape == want.shape == (3, 16, 16, 8)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_taps_stride1_rect():
+    """Non-square kernel, stride 1, asymmetric padding."""
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(2, 9, 11, 3)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 3, 3, 5)), jnp.float32)
+    got = dilated_conv_taps(x, k, None, strides=(1, 1), dilation=(2, 3),
+                            padding=((1, 2), (0, 3)))
+    want = _ref_conv(x, k, None, (1, 1), (2, 3), ((1, 2), (0, 3)))
+    assert got.shape == want.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_tapconv_param_tree_matches_nn_conv():
+    """Same param names, shapes, AND init values as nn.Conv (so the swap
+    is invisible to checkpoints, the flat codec, and init_weights)."""
+    tap = TapConv(features=8, kernel_size=(4, 4), strides=(2, 2),
+                  kernel_dilation=(16, 16), padding=((24, 24), (24, 24)))
+    ref = nn.Conv(features=8, kernel_size=(4, 4), strides=(2, 2),
+                  kernel_dilation=(16, 16), padding=((24, 24), (24, 24)))
+    x = jnp.zeros((1, 32, 32, 8), jnp.float32)
+    pt = tap.init(jax.random.PRNGKey(3), x)["params"]
+    pr = ref.init(jax.random.PRNGKey(3), x)["params"]
+    assert jax.tree.structure(pt) == jax.tree.structure(pr)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), pt, pr)
+    # and identical forward output under those params
+    yt = tap.apply({"params": pt}, x + 1.0)
+    yr = ref.apply({"params": pr}, x + 1.0)
+    np.testing.assert_allclose(np.asarray(yt), np.asarray(yr),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_tapconv_grads_match():
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.normal(size=(2, 32, 32, 8)), jnp.float32)
+    tap = TapConv(features=8, kernel_size=(4, 4), strides=(2, 2),
+                  kernel_dilation=(8, 8), padding=((12, 12), (12, 12)))
+    ref = nn.Conv(features=8, kernel_size=(4, 4), strides=(2, 2),
+                  kernel_dilation=(8, 8), padding=((12, 12), (12, 12)))
+    p = tap.init(jax.random.PRNGKey(0), x)["params"]
+
+    gt = jax.grad(lambda p: tap.apply({"params": p}, x).sum())(p)
+    gr = jax.grad(lambda p: ref.apply({"params": p}, x).sum())(p)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4), gt, gr)
