@@ -3,7 +3,7 @@
 Usage: python artifacts/probe_cpc_compile.py <piece> <Lc> [batch]
 
 Pieces: enc_fwd, enc_grad, stem_fwd, stem_grad, trunk_fwd, trunk_grad,
-        full_fwd, full_grad, closure
+        full_fwd, full_grad
 Each run jits ONE piece and prints the compile wall-clock; the caller
 bounds it with a subprocess timeout so a >20 min pathological compile
 just shows up as a kill.

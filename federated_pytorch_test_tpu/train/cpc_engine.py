@@ -112,18 +112,36 @@ class CPCTrainer:
         self._fn_cache: Dict[Any, Any] = {}
 
     # ------------------------------------------------------------------
-    def _forward(self, enc_p, ctx_p, pred_p, y, px: int, py: int):
-        """Encoder -> grid reshape -> contextgen -> predictor -> InfoNCE
-        (reference closure, federated_cpc.py:255-276)."""
+    # The reference closure runs encoder -> contextgen -> predictor ->
+    # InfoNCE on EVERY evaluation (federated_cpc.py:255-276) even though
+    # two of the three are frozen each round.  Here the pipeline is
+    # staged so the round builder can hoist the frozen prefix out of the
+    # LBFGS closure: it is loop-invariant per minibatch, and the line
+    # search alone re-evaluates the closure up to ~37 times — paying the
+    # wide dilated-conv encoder there to train two 1x1 convs is almost
+    # all of the predictor round's cost.  Values are identical either
+    # way; only the evaluation count changes.
+    def _encode_grid(self, enc_p, y, px: int, py: int):
+        """Encoder -> [B, px, py, latent] NHWC grid."""
         latents = self.models["encoder"].apply({"params": enc_p}, y)
         B = y.shape[0] // (px * py)
-        grid = latents.reshape(B, px, py, -1)           # NHWC grid
-        context = self.models["contextgen"].apply({"params": ctx_p}, grid)
+        return latents.reshape(B, px, py, -1)
+
+    def _context(self, ctx_p, grid):
+        """Contextgen on a latent grid."""
+        return self.models["contextgen"].apply({"params": ctx_p}, grid)
+
+    def _predict_loss(self, pred_p, grid, context):
+        """Predictor -> InfoNCE tail."""
         reduced, pred = self.models["predictor"].apply(
             {"params": pred_p}, grid, context)
         # Pallas-fused on TPU (ops/infonce.py); XLA path elsewhere —
         # identical math either way (tests assert equality)
         return info_nce_fused(reduced, pred)
+
+    def _head_loss(self, ctx_p, pred_p, grid):
+        """Contextgen -> predictor -> InfoNCE on a latent grid."""
+        return self._predict_loss(pred_p, grid, self._context(ctx_p, grid))
 
     def _build_round(self, mdl: str, ci: int, px: int, py: int):
         """Jitted (train Niter batches + fedavg + writeback) for one
@@ -143,7 +161,8 @@ class CPCTrainer:
         N = codec.masked_size(one, order, mask)
         lbfgs = self.lbfgs
         K = self.K
-        fwd = self._forward
+        encode_grid = self._encode_grid
+        head_loss = self._head_loss
 
         def per_client(enc_p, ctx_p, pred_p, os, ys):
             sub = {"encoder": enc_p, "contextgen": ctx_p,
@@ -152,14 +171,32 @@ class CPCTrainer:
 
             def step(carry, y):
                 xflat, os = carry
+                # hoist the FROZEN prefix of the pipeline out of the
+                # closure — it is constant across every closure
+                # (re-)evaluation this minibatch (see the staging note
+                # above); `mdl` is static, so each round's jit sees only
+                # its own specialization
+                if mdl == "encoder":
+                    def flat_loss(v):
+                        sub_v = codec.put_trainable_values(
+                            sub, order, mask, v)
+                        return head_loss(ctx_p, pred_p,
+                                         encode_grid(sub_v, y, px, py))
+                elif mdl == "contextgen":
+                    grid = encode_grid(enc_p, y, px, py)
 
-                def flat_loss(v):
-                    sub_v = codec.put_trainable_values(sub, order, mask, v)
-                    parts = {"encoder": enc_p, "contextgen": ctx_p,
-                             "predictor": pred_p}
-                    parts[mdl] = sub_v
-                    return fwd(parts["encoder"], parts["contextgen"],
-                               parts["predictor"], y, px, py)
+                    def flat_loss(v):
+                        sub_v = codec.put_trainable_values(
+                            sub, order, mask, v)
+                        return head_loss(sub_v, pred_p, grid)
+                else:                                   # predictor
+                    grid = encode_grid(enc_p, y, px, py)
+                    context = self._context(ctx_p, grid)
+
+                    def flat_loss(v):
+                        sub_v = codec.put_trainable_values(
+                            sub, order, mask, v)
+                        return self._predict_loss(sub_v, grid, context)
 
                 xflat, os, loss = lbfgs.step(flat_loss, xflat, os)
                 return (xflat, os), loss
