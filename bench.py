@@ -84,6 +84,8 @@ import numpy as np
 
 TARGET = 5000.0  # images/sec/chip (BASELINE.json north star)
 
+_HEADLINE_METRIC = "cifar10_resnet18_consensus_full_round_throughput"
+
 # peak dense bf16 FLOP/s per chip by device kind (public spec sheets);
 # default is TPU v5e
 _PEAK_BF16 = {
@@ -504,7 +506,7 @@ def _run_measurement(out: dict, attempts: Optional[int] = None,
 
 def main():
     out = {
-        "metric": "cifar10_resnet18_consensus_full_round_throughput",
+        "metric": _HEADLINE_METRIC,
         "value": 0.0,
         "unit": "images/sec/chip",
         "vs_baseline": 0.0,
@@ -528,7 +530,53 @@ def main():
             _run_measurement(out)
     except Exception as e:          # noqa: BLE001 — artifact must survive
         out["error"] = f"{type(e).__name__}: {e}"
+    if not out.get("measured"):
+        ref = _last_measured_artifact()
+        if ref is not None:
+            out["last_measured"] = ref
     print(json.dumps(out))
+
+
+def _last_measured_artifact() -> Optional[dict]:
+    """Pointer to the newest ``measured: true`` bench artifact under
+    artifacts/, embedded when THIS run could not measure — a relay wedge
+    at capture time (it cost round 4 its whole perf record) then cannot
+    erase hardware evidence captured earlier at the same or nearby HEAD.
+    Purely informational: ``value``/``measured`` still describe this run."""
+    base = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "artifacts")
+    best = None
+    try:
+        for name in os.listdir(base):
+            if not name.endswith(".json"):
+                continue
+            p = os.path.join(base, name)
+            try:
+                with open(p) as f:
+                    d = json.load(f)
+                mt = os.path.getmtime(p)
+            except (ValueError, OSError):
+                continue
+            # same headline metric AND a recorded chip: a CPU validation
+            # run (FEDTPU_BENCH_MEASURE_ON_CPU=1 marks measured but has
+            # meaningless numbers and records no TPU chip) or a
+            # different-metric artifact must not masquerade as prior
+            # hardware evidence
+            if not (isinstance(d, dict) and d.get("measured")
+                    and d.get("value")
+                    and d.get("metric") == _HEADLINE_METRIC
+                    and str(d.get("chip", "")).startswith("TPU")):
+                continue
+            if best is None or mt > best[0]:
+                best = (mt, {"path": f"artifacts/{name}",
+                             "value": d["value"],
+                             "vs_baseline": d.get("vs_baseline"),
+                             "metric": d.get("metric"),
+                             "chip": d.get("chip"),
+                             "mtime": int(mt)})
+    except OSError:
+        return None
+    return None if best is None else best[1]
 
 
 if __name__ == "__main__":
