@@ -16,7 +16,12 @@ still appears, with an ``"error"`` field and ``value = 0``:
 
 ``"measured"`` is True iff the headline was actually timed on a live
 backend; ``value = 0, measured = false`` is the wedged-relay signature
-(round 4's all-zeros artifact was misreadable as "measured 0").
+(round 4's all-zeros artifact was misreadable as "measured 0").  An
+unmeasured artifact additionally carries ``"last_measured"`` when a
+previous run's TPU-measured artifact of the same headline metric exists
+under artifacts/: ``{path, value, vs_baseline, metric, chip, mtime}`` —
+describing THAT earlier run, not this one (see
+:func:`_last_measured_artifact`).
 
 The reference publishes no quantitative numbers (BASELINE.md); the
 driver-set target is >=5,000 CIFAR10 images/sec/chip for the consensus
@@ -567,8 +572,11 @@ def _last_measured_artifact() -> Optional[dict]:
                     and d.get("metric") == _HEADLINE_METRIC
                     and str(d.get("chip", "")).startswith("TPU")):
                 continue
-            if best is None or mt > best[0]:
-                best = (mt, {"path": f"artifacts/{name}",
+            # (mtime, name) key: mtimes collapse to checkout time on a
+            # fresh clone, and the dated artifact filenames make the
+            # lexicographic tie-break deterministic and chronological
+            if best is None or (mt, name) > (best[0], best[1]):
+                best = (mt, name, {"path": f"artifacts/{name}",
                              "value": d["value"],
                              "vs_baseline": d.get("vs_baseline"),
                              "metric": d.get("metric"),
@@ -576,7 +584,7 @@ def _last_measured_artifact() -> Optional[dict]:
                              "mtime": int(mt)})
     except OSError:
         return None
-    return None if best is None else best[1]
+    return None if best is None else best[2]
 
 
 if __name__ == "__main__":

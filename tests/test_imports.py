@@ -23,6 +23,10 @@ CYCLE_CRITICAL = [
     "federated_pytorch_test_tpu",
     "federated_pytorch_test_tpu.ops",
     "federated_pytorch_test_tpu.ops.infonce",
+    # models.cpc now imports ops.dilated_conv, so models is one import
+    # away from the ops package and joins the quick-tier guard
+    "federated_pytorch_test_tpu.ops.dilated_conv",
+    "federated_pytorch_test_tpu.models.cpc",
     "federated_pytorch_test_tpu.train",
     "federated_pytorch_test_tpu.train.cpc_losses",
 ]
