@@ -535,11 +535,27 @@ def main():
             _run_measurement(out)
     except Exception as e:          # noqa: BLE001 — artifact must survive
         out["error"] = f"{type(e).__name__}: {e}"
+    out["captured_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                        time.gmtime())
     if not out.get("measured"):
         ref = _last_measured_artifact()
         if ref is not None:
             out["last_measured"] = ref
     print(json.dumps(out))
+
+
+def _parse_utc(stamp) -> Optional[float]:
+    """``captured_utc`` ("%Y-%m-%dT%H:%M[:%S]Z") -> epoch seconds, or
+    None when absent/malformed."""
+    if not isinstance(stamp, str):
+        return None
+    for fmt in ("%Y-%m-%dT%H:%M:%SZ", "%Y-%m-%dT%H:%MZ"):
+        try:
+            import calendar
+            return float(calendar.timegm(time.strptime(stamp, fmt)))
+        except ValueError:
+            continue
+    return None
 
 
 def _last_measured_artifact() -> Optional[dict]:
@@ -572,16 +588,19 @@ def _last_measured_artifact() -> Optional[dict]:
                     and d.get("metric") == _HEADLINE_METRIC
                     and str(d.get("chip", "")).startswith("TPU")):
                 continue
-            # (mtime, name) key: mtimes collapse to checkout time on a
-            # fresh clone, and the dated artifact filenames make the
-            # lexicographic tie-break deterministic and chronological
-            if best is None or (mt, name) > (best[0], best[1]):
-                best = (mt, name, {"path": f"artifacts/{name}",
-                             "value": d["value"],
-                             "vs_baseline": d.get("vs_baseline"),
-                             "metric": d.get("metric"),
-                             "chip": d.get("chip"),
-                             "mtime": int(mt)})
+            # chronology: the in-artifact capture stamp when present
+            # (mtimes collapse to checkout time on a fresh clone and the
+            # mixed file-naming schemes do not sort chronologically),
+            # else the mtime; name breaks exact ties deterministically
+            ts = _parse_utc(d.get("captured_utc")) or mt
+            if best is None or (ts, name) > (best[0], best[1]):
+                best = (ts, name, {"path": f"artifacts/{name}",
+                                   "value": d["value"],
+                                   "vs_baseline": d.get("vs_baseline"),
+                                   "metric": d.get("metric"),
+                                   "chip": d.get("chip"),
+                                   "captured_utc": d.get("captured_utc"),
+                                   "mtime": int(mt)})
     except OSError:
         return None
     return None if best is None else best[2]
