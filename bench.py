@@ -78,6 +78,7 @@ real).
 
 from __future__ import annotations
 
+import calendar
 import json
 import os
 import subprocess
@@ -551,7 +552,6 @@ def _parse_utc(stamp) -> Optional[float]:
         return None
     for fmt in ("%Y-%m-%dT%H:%M:%SZ", "%Y-%m-%dT%H:%MZ"):
         try:
-            import calendar
             return float(calendar.timegm(time.strptime(stamp, fmt)))
         except ValueError:
             continue
@@ -588,13 +588,16 @@ def _last_measured_artifact() -> Optional[dict]:
                     and d.get("metric") == _HEADLINE_METRIC
                     and str(d.get("chip", "")).startswith("TPU")):
                 continue
-            # chronology: the in-artifact capture stamp when present
-            # (mtimes collapse to checkout time on a fresh clone and the
-            # mixed file-naming schemes do not sort chronologically),
-            # else the mtime; name breaks exact ties deterministically
-            ts = _parse_utc(d.get("captured_utc")) or mt
-            if best is None or (ts, name) > (best[0], best[1]):
-                best = (ts, name, {"path": f"artifacts/{name}",
+            # chronology: stamped artifacts win over unstamped
+            # CATEGORICALLY (an unstamped file's mtime collapses to
+            # checkout time on a fresh clone, which would beat every
+            # genuine capture stamp), then the capture stamp (or mtime
+            # among unstamped), then name to break exact ties
+            stamp = _parse_utc(d.get("captured_utc"))
+            key = (stamp is not None, stamp if stamp is not None else mt,
+                   name)
+            if best is None or key > best[0]:
+                best = (key, {"path": f"artifacts/{name}",
                                    "value": d["value"],
                                    "vs_baseline": d.get("vs_baseline"),
                                    "metric": d.get("metric"),
@@ -603,7 +606,7 @@ def _last_measured_artifact() -> Optional[dict]:
                                    "mtime": int(mt)})
     except OSError:
         return None
-    return None if best is None else best[2]
+    return None if best is None else best[1]
 
 
 if __name__ == "__main__":

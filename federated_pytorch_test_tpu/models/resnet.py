@@ -117,10 +117,10 @@ class BasicBlock(nn.Module):
         w = sample_weight
         in_planes = x.shape[-1]
         out = nn.Conv(self.planes, (3, 3), strides=(self.stride, self.stride),
-                      padding="SAME", use_bias=False, dtype=self.dtype,
+                      padding=((1, 1), (1, 1)), use_bias=False, dtype=self.dtype,
                       name="conv1")(x)
         out = elu(_apply_norm(self.norm, "bn1", out, train, w))
-        out = nn.Conv(self.planes, (3, 3), padding="SAME", use_bias=False,
+        out = nn.Conv(self.planes, (3, 3), padding=((1, 1), (1, 1)), use_bias=False,
                       dtype=self.dtype, name="conv2")(out)
         out = _apply_norm(self.norm, "bn2", out, train, w)
         if self.stride != 1 or in_planes != self.expansion * self.planes:
@@ -155,7 +155,7 @@ class Bottleneck(nn.Module):
                       name="conv1")(x)
         out = elu(_apply_norm(self.norm, "bn1", out, train, w))
         out = nn.Conv(self.planes, (3, 3), strides=(self.stride, self.stride),
-                      padding="SAME", use_bias=False, dtype=self.dtype,
+                      padding=((1, 1), (1, 1)), use_bias=False, dtype=self.dtype,
                       name="conv2")(out)
         out = elu(_apply_norm(self.norm, "bn2", out, train, w))
         out = nn.Conv(self.expansion * self.planes, (1, 1), use_bias=False,
@@ -192,7 +192,7 @@ class ResNet(BlockModule):
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True,
                  sample_weight=None) -> jnp.ndarray:
-        out = nn.Conv(64, (3, 3), padding="SAME", use_bias=False,
+        out = nn.Conv(64, (3, 3), padding=((1, 1), (1, 1)), use_bias=False,
                       dtype=self.dtype, name="conv1")(x)
         out = elu(_apply_norm(self.norm, "bn1", out, train, sample_weight))
         block_cls = Bottleneck if self.bottleneck else BasicBlock

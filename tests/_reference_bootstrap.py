@@ -1,0 +1,29 @@
+"""Shared bootstrap for tests that cross-check against the ACTUAL
+reference implementation at /root/reference (imported read-only, never
+copied).  Call at module scope:
+
+    torch, ref_mod = reference_module("simple_models")
+
+Skips the whole module when torch or the reference checkout is absent
+(e.g. a standalone checkout of this repo).
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import sys
+
+import pytest
+
+REF_SRC = "/root/reference/src"
+
+
+def reference_module(name: str):
+    torch = pytest.importorskip("torch")
+    if not os.path.exists(os.path.join(REF_SRC, f"{name}.py")):
+        pytest.skip("reference checkout not available",
+                    allow_module_level=True)
+    if REF_SRC not in sys.path:
+        sys.path.insert(0, REF_SRC)
+    return torch, importlib.import_module(name)

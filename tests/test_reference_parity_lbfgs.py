@@ -17,19 +17,11 @@ checkout of this repo).
 
 from __future__ import annotations
 
-import os
-import sys
-
 import numpy as np
-import pytest
 
-REF_SRC = "/root/reference/src"
+from _reference_bootstrap import reference_module
 
-torch = pytest.importorskip("torch")
-if not os.path.exists(os.path.join(REF_SRC, "lbfgsnew.py")):
-    pytest.skip("reference checkout not available", allow_module_level=True)
-sys.path.insert(0, REF_SRC)
-import lbfgsnew as ref_lbfgs  # noqa: E402
+torch, ref_lbfgs = reference_module("lbfgsnew")
 
 
 def _quadratic(dim=16, seed=3):

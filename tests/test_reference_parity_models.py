@@ -1,0 +1,151 @@
+"""Forward-pass cross-check: our flax models vs the ACTUAL reference
+torch models under IDENTICAL weights.
+
+The reference's ``simple_models.py`` is definition-only and importable
+(torch CPU); nothing is copied.  Each case initialises the torch model,
+maps its parameters leaf-by-leaf into our layout — OIHW conv kernels ->
+HWIO, ``[out, in]`` linear -> ``[in, out]``, and the conv->fc boundary's
+flatten permutation (torch flattens NCHW so fc1's input order is
+(C, H, W); flax flattens NHWC so ours is (H, W, C)) — then asserts the
+two forwards agree on random input.  This pins down layout conventions,
+activation choices (ELU), pooling, padding, the BatchNorm eval path, and
+the TapConv stem (vs torch's true dilated convs) in one go.
+
+Skipped when /root/reference or torch is unavailable.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from _reference_bootstrap import reference_module
+
+torch, ref_models = reference_module("simple_models")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from federated_pytorch_test_tpu.models import (  # noqa: E402
+    ContextgenCNN,
+    EncoderCNN,
+    Net,
+    Net1,
+    Net2,
+    PredictorCNN,
+    ResNet9,
+    ResNet18,
+)
+from federated_pytorch_test_tpu.utils import blocks as blocklib  # noqa: E402
+from federated_pytorch_test_tpu.utils import codec  # noqa: E402
+
+
+def _torch_flat(tnet, first_fc=None, chw=None) -> np.ndarray:
+    """Flatten torch params in enumeration order, each leaf transformed
+    to our layout first (so the segment ravels match our leaves)."""
+    segs = []
+    for name, p in tnet.named_parameters():
+        w = p.detach().numpy().astype(np.float32)
+        if w.ndim == 4:                       # conv OIHW -> HWIO
+            w = np.transpose(w, (2, 3, 1, 0))
+        elif w.ndim == 2:                     # linear [out, in] -> [in, out]
+            if name == first_fc:
+                C, H, W = chw                 # flatten-permutation boundary
+                w = (w.reshape(w.shape[0], C, H, W)
+                     .transpose(2, 3, 1, 0)
+                     .reshape(H * W * C, w.shape[0]))
+            else:
+                w = w.T
+        segs.append(w.ravel())
+    return np.concatenate(segs)
+
+
+def _load_into_ours(model, params, flat: np.ndarray):
+    order = model.param_order()
+    mask = blocklib.build_mask(
+        jax.tree.map(lambda _: 0, params),
+        blocklib.block_paths(order, [0, len(order) - 1]))
+    assert codec.masked_size(params, order, mask) == flat.size, (
+        "parameter count mismatch vs the reference enumeration")
+    return codec.put_trainable_values(params, order, mask,
+                                      jnp.asarray(flat))
+
+
+def _check(tnet, model, x_nchw, first_fc=None, chw=None, atol=1e-4,
+           apply_kwargs=None, out_nchw=False):
+    tnet.eval()
+    with torch.no_grad():
+        want = tnet(torch.tensor(x_nchw)).numpy()
+    if out_nchw:                    # conv-shaped torch output -> NHWC
+        want = np.transpose(want, (0, 2, 3, 1))
+    x = jnp.asarray(np.transpose(x_nchw, (0, 2, 3, 1)))
+    params, batch_stats = model.init_variables(jax.random.PRNGKey(0), x,
+                                               **(apply_kwargs or {}))
+    params = _load_into_ours(model, params, _torch_flat(tnet, first_fc, chw))
+    variables = {"params": params}
+    if batch_stats:
+        variables["batch_stats"] = batch_stats
+    got = model.apply(variables, x, **(apply_kwargs or {}))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=0, atol=atol)
+
+
+def _x(shape, seed=0):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+@pytest.mark.parametrize("tcls,ours,first_fc,chw", [
+    (ref_models.Net, Net, "fc1.weight", (16, 5, 5)),
+    (ref_models.Net1, Net1, "fc1.weight", (64, 5, 5)),
+    (ref_models.Net2, Net2, "fc1.weight", (512, 2, 2)),
+])
+def test_classifier_forward_matches_reference(tcls, ours, first_fc, chw):
+    torch.manual_seed(11)
+    _check(tcls(), ours(), _x((4, 3, 32, 32)), first_fc=first_fc, chw=chw,
+           apply_kwargs={"train": False})
+
+
+@pytest.mark.parametrize("tfac,ours", [
+    (ref_models.ResNet9, ResNet9),
+    (ref_models.ResNet18, ResNet18),
+])
+def test_resnet_forward_matches_reference(tfac, ours):
+    # after avg_pool the flat axis is channels-only: no fc permutation
+    torch.manual_seed(13)
+    _check(tfac(), ours(), _x((4, 3, 32, 32)), atol=2e-4,
+           apply_kwargs={"train": False})
+
+
+def test_cpc_encoder_matches_reference():
+    """Also pins TapConv (im2col stem) against torch's TRUE dilated
+    convolutions, independently of lax.conv_general_dilated."""
+    torch.manual_seed(17)
+    _check(ref_models.EncoderCNN(latent_dim=64), EncoderCNN(latent_dim=64),
+           _x((4, 8, 32, 32)), atol=1e-4)
+
+
+def test_cpc_contextgen_matches_reference():
+    torch.manual_seed(19)
+    _check(ref_models.ContextgenCNN(latent_dim=32),
+           ContextgenCNN(latent_dim=32), _x((2, 32, 3, 3)), atol=1e-5,
+           out_nchw=True)
+
+
+def test_cpc_predictor_matches_reference():
+    torch.manual_seed(23)
+    tnet = ref_models.PredictorCNN(latent_dim=32, reduced_dim=8)
+    model = PredictorCNN(latent_dim=32, reduced_dim=8)
+    lat_nchw = _x((2, 32, 3, 3))
+    ctx_nchw = _x((2, 32, 3, 3), seed=1)
+    tnet.eval()
+    with torch.no_grad():
+        want_rl, want_pred = tnet(torch.tensor(lat_nchw),
+                                  torch.tensor(ctx_nchw))
+    lat = jnp.asarray(np.transpose(lat_nchw, (0, 2, 3, 1)))
+    ctx = jnp.asarray(np.transpose(ctx_nchw, (0, 2, 3, 1)))
+    params, _ = model.init_variables(jax.random.PRNGKey(0), lat, ctx)
+    params = _load_into_ours(model, params, _torch_flat(tnet))
+    got_rl, got_pred = model.apply({"params": params}, lat, ctx)
+    for got, want in ((got_rl, want_rl), (got_pred, want_pred)):
+        np.testing.assert_allclose(
+            np.asarray(got), np.transpose(want.numpy(), (0, 2, 3, 1)),
+            rtol=0, atol=1e-5)
