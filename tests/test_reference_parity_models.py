@@ -149,3 +149,141 @@ def test_cpc_predictor_matches_reference():
         np.testing.assert_allclose(
             np.asarray(got), np.transpose(want.numpy(), (0, 2, 3, 1)),
             rtol=0, atol=1e-5)
+
+
+# ----------------------------------------------------------------------
+# VAE (C5): encode/decode cross-checked separately (the reparam draw is
+# RNG-backend-specific by design; its math is exercised via decode on a
+# fixed z).  Two extra layout mappings appear here: fc3's OUTPUT units
+# are permuted (torch reshapes its 384-vector to (C,H,W)=(96,2,2), ours
+# to (H,W,C)), and torch ConvTranspose2d(k=4,s=2,p=1) equals flax
+# ConvTranspose(SAME) with the SPATIALLY FLIPPED kernel (verified to
+# 1e-7; the conventions differ by a rot180).
+# ----------------------------------------------------------------------
+
+def _perm_in_384(w):
+    """[out, 384+tail]: permute the conv-feature block of input columns
+    from (C,H,W)=(96,2,2) to (H,W,C), keep any tail columns (e.g. the
+    concatenated e_k), -> flax [in, out]."""
+    out = w.shape[0]
+    head = (w[:, :384].reshape(out, 96, 2, 2).transpose(2, 3, 1, 0)
+            .reshape(384, out))
+    return np.concatenate([head, w[:, 384:].T], axis=0)
+
+
+def _perm_out_384(w):
+    """[out=384, in]: permute the OUTPUT units (C,H,W)->(H,W,C), -> flax
+    [in, out]."""
+    return (w.reshape(96, 2, 2, w.shape[1]).transpose(1, 2, 0, 3)
+            .reshape(384, w.shape[1]).T)
+
+
+def _perm_out_384_bias(b):
+    return b.reshape(96, 2, 2).transpose(1, 2, 0).ravel()
+
+
+def _vae_family_flat(tnet, in_perm, out_perm) -> np.ndarray:
+    """Flatten a torch (clustering-)VAE's params into our layout.
+    ``in_perm``: fc names whose INPUT columns start with the 384
+    conv-feature block; ``out_perm``: fc names whose OUTPUT units feed
+    the (96,2,2) deconv reshape."""
+    segs = []
+    for name, p in tnet.named_parameters():
+        w = p.detach().numpy().astype(np.float32)
+        stem = name.split(".")[0]
+        if name.startswith("tconv") and w.ndim == 4:
+            # torch [in, out, kh, kw] -> flax [kh, kw, in, out], rot180
+            w = np.transpose(w, (2, 3, 0, 1))[::-1, ::-1]
+        elif w.ndim == 4:                     # conv OIHW -> HWIO
+            w = np.transpose(w, (2, 3, 1, 0))
+        elif stem in in_perm and name.endswith(".weight"):
+            w = _perm_in_384(w)
+        elif stem in out_perm and name.endswith(".weight"):
+            w = _perm_out_384(w)
+        elif stem in out_perm:                # the matching bias
+            w = _perm_out_384_bias(w)
+        elif w.ndim == 2:
+            w = w.T
+        segs.append(w.ravel())
+    return np.concatenate(segs)
+
+
+def test_vae_encode_decode_match_reference():
+    from federated_pytorch_test_tpu.models import AutoEncoderCNN
+
+    torch.manual_seed(29)
+    tnet = ref_models.AutoEncoderCNN()
+    tnet.eval()
+    model = AutoEncoderCNN()
+    x_nchw = _x((3, 3, 32, 32))
+    z_np = _x((3, 10), seed=2)
+    with torch.no_grad():
+        want_mu, want_logvar = tnet.encode(torch.tensor(x_nchw))
+        want_dec = tnet.decode(torch.tensor(z_np)).numpy()
+    x = jnp.asarray(np.transpose(x_nchw, (0, 2, 3, 1)))
+    params, _ = model.init_variables(jax.random.PRNGKey(0), x,
+                                     jax.random.PRNGKey(1))
+    params = _load_into_ours(model, params, _vae_family_flat(
+        tnet, in_perm={"fc1"}, out_perm={"fc3"}))
+    got_mu, got_logvar = model.apply({"params": params}, x,
+                                     method=model.encode)
+    np.testing.assert_allclose(np.asarray(got_mu), want_mu.numpy(),
+                               rtol=0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_logvar), want_logvar.numpy(),
+                               rtol=0, atol=1e-5)
+    got_dec = model.apply({"params": params}, jnp.asarray(z_np),
+                          method=model.decode)
+    np.testing.assert_allclose(np.asarray(got_dec),
+                               np.transpose(want_dec, (0, 2, 3, 1)),
+                               rtol=0, atol=1e-5)
+
+
+# ----------------------------------------------------------------------
+# Clustering VAE (C6): the deterministic submodels encodeclus / encode /
+# decode are cross-checked directly.  Extra boundaries beyond the plain
+# VAE: fc21's INPUT is concat([conv-features(384), e_k(K)]) so only its
+# first 384 input columns take the flatten permutation, and fc25 is the
+# fc->deconv output-permutation boundary.
+# ----------------------------------------------------------------------
+
+def test_vae_cl_submodels_match_reference():
+    from federated_pytorch_test_tpu.models import AutoEncoderCNNCL
+
+    K, L = 10, 32
+    torch.manual_seed(31)
+    tnet = ref_models.AutoEncoderCNNCL(K=K, L=L)
+    tnet.eval()
+    model = AutoEncoderCNNCL(K=K, L=L)
+    x_nchw = _x((3, 3, 32, 32))
+    ek_np = np.eye(K, dtype=np.float32)[[2, 7, 4]]   # one-hot rows
+    z_np = _x((3, L), seed=4)
+    with torch.no_grad():
+        want_clus = tnet.encodeclus(torch.tensor(x_nchw)).numpy()
+        want_mu, want_sig2 = tnet.encode(torch.tensor(x_nchw),
+                                         torch.tensor(ek_np))
+        want_dec = [t.numpy() for t in tnet.decode(torch.tensor(ek_np),
+                                                   torch.tensor(z_np))]
+    x = jnp.asarray(np.transpose(x_nchw, (0, 2, 3, 1)))
+    params, _ = model.init_variables(jax.random.PRNGKey(0), x,
+                                     jax.random.PRNGKey(1))
+    params = _load_into_ours(model, params, _vae_family_flat(
+        tnet, in_perm={"fc11", "fc21"}, out_perm={"fc25"}))
+    v = {"params": params}
+
+    got_clus = model.apply(v, x, method=model.encodeclus)
+    np.testing.assert_allclose(np.asarray(got_clus), want_clus,
+                               rtol=0, atol=1e-5)
+    got_mu, got_sig2 = model.apply(v, x, jnp.asarray(ek_np),
+                                   method=model.encode)
+    np.testing.assert_allclose(np.asarray(got_mu), want_mu.numpy(),
+                               rtol=0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_sig2), want_sig2.numpy(),
+                               rtol=0, atol=1e-5)
+    got_dec = model.apply(v, jnp.asarray(ek_np), jnp.asarray(z_np),
+                          method=model.decode)
+    # mu_b, sig2_b are [B, L]; mu_th, sig2_th are conv-shaped
+    for got, want, conv in zip(got_dec, want_dec,
+                               (False, False, True, True)):
+        want = np.transpose(want, (0, 2, 3, 1)) if conv else want
+        np.testing.assert_allclose(np.asarray(got), want, rtol=0,
+                                   atol=1e-5)
