@@ -341,17 +341,24 @@ def _bench_cpc() -> dict:
     warm-up rotation pays the compiles) and the patch throughput the
     LBFGS closures sustain; the artifact records the dims it ran at.
 
-    Runs at Lc=64, batch 32 — NOT the reference's Lc=256/batch 128:
+    Defaults to Lc=64, batch 32 — NOT the reference's Lc=256/batch 128:
     at that width the jitted CPC round (LBFGS closure re-evaluations x
-    wide dilated-conv encoder) currently triggers a pathological XLA:TPU
-    compile that exceeds the relay compiler's budget (observed: >20 min,
-    then compiler-host death; round-5 session log).  The reduced dims
-    compile in seconds and exercise the identical graph shape.  Skip
-    entirely with FEDTPU_BENCH_CPC=0."""
+    wide encoder) has triggered a pathological XLA:TPU compile that
+    exceeds the relay compiler's budget (observed: >20 min, then
+    compiler-host death; round-5 session log — see README "Known
+    issues" for the isolation results).  The reduced dims compile in
+    seconds and exercise the identical graph shape.  Override with
+    FEDTPU_BENCH_CPC_LC / FEDTPU_BENCH_CPC_BATCH (e.g. 256/128 for
+    reference width once a relay window permits); skip entirely with
+    FEDTPU_BENCH_CPC=0."""
     from federated_pytorch_test_tpu.data.lofar import CPCDataSource
     from federated_pytorch_test_tpu.train.cpc_engine import CPCTrainer
 
-    Lc, Rc, batch, niter = 64, 16, 32, 10
+    Lc = int(os.environ.get("FEDTPU_BENCH_CPC_LC", 64))
+    batch = int(os.environ.get("FEDTPU_BENCH_CPC_BATCH", 32))
+    # reference pairing: Rc=32 at Lc=256 (federated_cpc.py:27-29);
+    # scale Rc down with Lc below that
+    Rc, niter = min(32, max(Lc // 4, 8)), 10
     src = CPCDataSource([f"bench{i}.h5" for i in range(4)], ["0"] * 4,
                         batch_size=batch, patch_size=32)
     trainer = CPCTrainer(src, latent_dim=Lc, reduced_dim=Rc,
