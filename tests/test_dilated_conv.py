@@ -93,3 +93,35 @@ def test_tapconv_grads_match():
     gr = jax.grad(lambda p: ref.apply({"params": p}, x).sum())(p)
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4), gt, gr)
+
+
+def test_tapconv_on_tpu_matches_dilated_conv():
+    """TapConv vs lax dilated conv ON THE TPU BACKEND (fwd + grad) at the
+    worst stem config (dilation 16, receptive span 49 px > 32 px input).
+    Skipped off-TPU: run via ``FEDTPU_TEST_TPU=1 pytest
+    tests/test_dilated_conv.py`` on a TPU host — a Mosaic/XLA:TPU
+    divergence in either lowering must surface here, not in training."""
+    if jax.default_backend() != "tpu":
+        pytest.skip("real TPU backend required (FEDTPU_TEST_TPU=1)")
+    rng = np.random.default_rng(31)
+    x = jnp.asarray(rng.normal(size=(8, 32, 32, 8)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(4, 4, 8, 8)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(8,)), jnp.float32)
+    args = dict(strides=(2, 2), dilation=(16, 16),
+                padding=((24, 24), (24, 24)))
+
+    def tap(x, k, b):
+        return jnp.sum(dilated_conv_taps(x, k, b, **args) ** 2)
+
+    def ref(x, k, b):
+        return jnp.sum(_ref_conv(x, k, b, args["strides"],
+                                 args["dilation"], args["padding"]) ** 2)
+
+    got_v, got_g = jax.jit(jax.value_and_grad(tap, argnums=(0, 1, 2)))(
+        x, k, b)
+    want_v, want_g = jax.jit(jax.value_and_grad(ref, argnums=(0, 1, 2)))(
+        x, k, b)
+    np.testing.assert_allclose(float(got_v), float(want_v), rtol=1e-5)
+    for g, w in zip(got_g, want_g):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=1e-4, atol=1e-4)
