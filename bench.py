@@ -545,6 +545,14 @@ def main():
         out["error"] = f"{type(e).__name__}: {e}"
     out["captured_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                         time.gmtime())
+    try:        # which code produced this artifact (self-description only);
+        # --dirty so an uncommitted tree cannot masquerade as its HEAD
+        out["git"] = subprocess.run(
+            ["git", "-C", os.path.dirname(os.path.abspath(__file__)),
+             "describe", "--always", "--dirty"],
+            capture_output=True, text=True, timeout=10).stdout.strip() or None
+    except (OSError, subprocess.SubprocessError):
+        out["git"] = None
     if not out.get("measured"):
         ref = _last_measured_artifact()
         if ref is not None:
