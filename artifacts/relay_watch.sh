@@ -1,0 +1,20 @@
+#!/bin/bash
+# Poll the axon relay; at the first healthy window run (1) the
+# reference-width CPC round AOT-compile probe, (2) a fresh full bench.
+# Each step bounded; output under artifacts/.
+cd /root/repo
+for i in $(seq 1 90); do
+  if timeout 60 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" 2>/dev/null; then
+    echo "$(date -u +%H:%M:%S) relay healthy (attempt $i)" >> artifacts/relay_watch.log
+    echo "== AOT probe Lc=256" >> artifacts/relay_watch.log
+    PYTHONPATH=/root/repo:/root/.axon_site timeout 1500 python artifacts/probe_cpc_aot.py 256 128 10 encoder 0 >> artifacts/relay_watch.log 2>&1
+    echo "rc=$?" >> artifacts/relay_watch.log
+    echo "== bench attempt 2" >> artifacts/relay_watch.log
+    timeout 5400 python bench.py > artifacts/bench_r05_attempt2.out 2> artifacts/bench_r05_attempt2.err
+    echo "bench rc=$?" >> artifacts/relay_watch.log
+    exit 0
+  fi
+  echo "$(date -u +%H:%M:%S) relay wedged (attempt $i)" >> artifacts/relay_watch.log
+  sleep 240
+done
+echo "gave up after 90 attempts" >> artifacts/relay_watch.log
