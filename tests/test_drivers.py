@@ -124,6 +124,23 @@ class TestDriverCLI:
         assert p.parse_args(["--device-data"]).device_data is True
         assert p.parse_args(["--no-device-data"]).device_data is False
 
+    def test_every_reference_knob_has_a_flag(self):
+        """EVERY module-level constant of the reference driver skeleton
+        (SURVEY.md section 5 config inventory: federated_multi.py:9-48 +
+        the consensus BB knobs) parses as a CLI flag with its reference
+        name (``use_cuda`` -> ``use_tpu`` per BASELINE.json)."""
+        from federated_pytorch_test_tpu.drivers.consensus_multi import DEFAULTS
+        from federated_pytorch_test_tpu.drivers.common import build_parser
+        p = build_parser(DEFAULTS, "consensus_multi")
+        knobs = ["K", "default_batch", "Nloop", "Nepoch", "Nadmm",
+                 "lambda1", "lambda2", "admm_rho0", "load_model",
+                 "init_model", "save_model", "check_results",
+                 "biased_input", "be_verbose", "use_resnet", "use_tpu",
+                 "bb_update", "bb_period_T", "bb_rhomax"]
+        args = p.parse_args([])
+        for k in knobs:
+            assert hasattr(args, k), f"reference knob {k} has no CLI flag"
+
     @pytest.mark.slow   # two full driver runs; engine-level resume is
     #                     covered fast in tests/test_resume.py
     def test_midrun_checkpoint_flag_saves_and_resumes(self, tmp_path,
