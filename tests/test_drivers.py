@@ -136,7 +136,8 @@ class TestDriverCLI:
                  "lambda1", "lambda2", "admm_rho0", "load_model",
                  "init_model", "save_model", "check_results",
                  "biased_input", "be_verbose", "use_resnet", "use_tpu",
-                 "bb_update", "bb_period_T", "bb_rhomax"]
+                 "bb_update", "bb_period_T", "bb_rhomax", "bb_alphacorrmin",
+                 "bb_epsilon"]
         args = p.parse_args([])
         for k in knobs:
             assert hasattr(args, k), f"reference knob {k} has no CLI flag"
