@@ -2,14 +2,16 @@
 
 Imports the reference's ``lbfgsnew.py`` (torch, CPU) straight from
 /root/reference — nothing is copied — and runs both optimizers on the
-same deterministic quadratic in float64.  Batch mode's backtracking line
-search uses only function values (reference lbfgsnew.py:124-196), so the
-two implementations make identical decisions and the parameter
-trajectories must agree to float64 tolerance step by step.  The
-full-batch cubic search is a documented parity+ deviation (exact
-``value_and_grad`` phi' instead of the reference's central differences,
-optim/lbfgs.py), so it gets a convergence-equivalence check instead of a
-bitwise one.
+same deterministic objectives in float64.  Batch mode's backtracking
+line search uses only function values (reference lbfgsnew.py:124-196),
+so the two implementations make identical decisions and the parameter
+trajectories must agree step by step to float64 tolerance — on a
+quadratic, on Rosenbrock, and in a stochastic changing-batch regime
+that drives the batch-change detection and adaptive ``alphabar``
+(lbfgsnew.py:600-615).  The full-batch cubic search is a documented
+parity+ deviation (exact ``value_and_grad`` phi' instead of central
+differences, optim/lbfgs.py), but central differences are exact on a
+quadratic, so there too the trajectories must coincide.
 
 Skipped when /root/reference or torch is unavailable (e.g. a standalone
 checkout of this repo).
@@ -24,6 +26,58 @@ from _reference_bootstrap import reference_module
 torch, ref_lbfgs = reference_module("lbfgsnew")
 
 
+def _run_reference(torch_loss, x0, steps, **kw):
+    """Trajectory of the reference optimizer.  ``torch_loss(xt, i)``
+    builds the torch loss for step ``i`` (ignore ``i`` for a fixed
+    objective)."""
+    xt = torch.tensor(x0, dtype=torch.float64, requires_grad=True)
+    opt = ref_lbfgs.LBFGSNew([xt], **kw)
+    traj = []
+    for i in range(steps):
+        def closure():
+            opt.zero_grad()
+            loss = torch_loss(xt, i)
+            if loss.requires_grad:
+                loss.backward()
+            return loss
+
+        opt.step(closure)
+        traj.append(xt.detach().numpy().copy())
+    return traj
+
+
+def _run_ours(jax_loss, x0, steps, **kw):
+    """Trajectory of our optimizer under f64.  ``jax_loss(x, i)`` builds
+    the jax loss for step ``i``; the x64 flag is saved and restored."""
+    import jax
+
+    prev = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", True)
+    try:
+        import jax.numpy as jnp
+
+        from federated_pytorch_test_tpu.optim.lbfgs import LBFGSNew
+
+        opt = LBFGSNew(**kw)
+        x = jnp.asarray(x0, jnp.float64)
+        st = opt.init(x)
+        traj = []
+        for i in range(steps):
+            x, st, _ = opt.step(lambda v: jax_loss(v, i), x, st)
+            traj.append(np.asarray(x).copy())
+        return traj
+    finally:
+        jax.config.update("jax_enable_x64", prev)
+
+
+def _assert_trajectories_match(ref, got, tol, what):
+    for i, (r, g) in enumerate(zip(ref, got)):
+        np.testing.assert_allclose(
+            g, r, rtol=tol, atol=tol,
+            err_msg=f"{what}: trajectory diverged from the reference "
+                    f"at step {i}")
+
+
 def _quadratic(dim=16, seed=3):
     """0.5 x^T A x - b^T x with a fixed, well-conditioned SPD A."""
     rng = np.random.default_rng(seed)
@@ -35,51 +89,8 @@ def _quadratic(dim=16, seed=3):
     return A, b, x0
 
 
-def _run_reference(A, b, x0, steps, **kw):
-    xt = torch.tensor(x0, dtype=torch.float64, requires_grad=True)
-    At = torch.tensor(A, dtype=torch.float64)
-    bt = torch.tensor(b, dtype=torch.float64)
-    opt = ref_lbfgs.LBFGSNew([xt], **kw)
-
-    def closure():
-        opt.zero_grad()
-        loss = 0.5 * xt @ (At @ xt) - bt @ xt
-        if loss.requires_grad:
-            loss.backward()
-        return loss
-
-    traj = []
-    for _ in range(steps):
-        opt.step(closure)
-        traj.append(xt.detach().numpy().copy())
-    return traj
-
-
-def _run_ours(A, b, x0, steps, **kw):
-    import jax
-
-    jax.config.update("jax_enable_x64", True)
-    try:
-        import jax.numpy as jnp
-
-        from federated_pytorch_test_tpu.optim.lbfgs import LBFGSNew
-
-        Aj = jnp.asarray(A, jnp.float64)
-        bj = jnp.asarray(b, jnp.float64)
-
-        def loss_fn(x):
-            return 0.5 * x @ (Aj @ x) - bj @ x
-
-        opt = LBFGSNew(**kw)
-        x = jnp.asarray(x0, jnp.float64)
-        st = opt.init(x)
-        traj = []
-        for _ in range(steps):
-            x, st, _ = opt.step(loss_fn, x, st)
-            traj.append(np.asarray(x).copy())
-        return traj
-    finally:
-        jax.config.update("jax_enable_x64", False)
+BATCH_KW = dict(history_size=7, max_iter=2, line_search_fn=True,
+                batch_mode=True)
 
 
 def test_batch_mode_trajectory_matches_reference():
@@ -88,14 +99,13 @@ def test_batch_mode_trajectory_matches_reference():
     configuration every active reference call site uses
     (federated_cpc.py:242-248, federated_vae_cl.py:205)."""
     A, b, x0 = _quadratic()
-    kw = dict(history_size=7, max_iter=2, line_search_fn=True,
-              batch_mode=True)
-    ref = _run_reference(A, b, x0, steps=5, **kw)
-    got = _run_ours(A, b, x0, steps=5, **kw)
-    for i, (r, g) in enumerate(zip(ref, got)):
-        np.testing.assert_allclose(
-            g, r, rtol=1e-9, atol=1e-9,
-            err_msg=f"trajectory diverged from the reference at step {i}")
+    At, bt = torch.tensor(A), torch.tensor(b)
+
+    ref = _run_reference(lambda xt, i: 0.5 * xt @ (At @ xt) - bt @ xt,
+                         x0, steps=5, **BATCH_KW)
+    got = _run_ours(lambda x, i: 0.5 * x @ (A @ x) - b @ x,
+                    x0, steps=5, **BATCH_KW)
+    _assert_trajectories_match(ref, got, 1e-9, "quadratic batch mode")
 
 
 def test_full_batch_cubic_trajectory_matches_reference():
@@ -106,11 +116,61 @@ def test_full_batch_cubic_trajectory_matches_reference():
     the reference quirk that step 3 lands slightly FARTHER from the
     minimum than step 2 (both sides reproduce it)."""
     A, b, x0 = _quadratic()
+    At, bt = torch.tensor(A), torch.tensor(b)
     kw = dict(history_size=7, max_iter=10, line_search_fn=True,
               batch_mode=False)
-    ref = _run_reference(A, b, x0, steps=3, **kw)
-    got = _run_ours(A, b, x0, steps=3, **kw)
-    for i, (r, g) in enumerate(zip(ref, got)):
-        np.testing.assert_allclose(
-            g, r, rtol=1e-7, atol=1e-7,
-            err_msg=f"trajectory diverged from the reference at step {i}")
+    ref = _run_reference(lambda xt, i: 0.5 * xt @ (At @ xt) - bt @ xt,
+                         x0, steps=3, **kw)
+    got = _run_ours(lambda x, i: 0.5 * x @ (A @ x) - b @ x,
+                    x0, steps=3, **kw)
+    _assert_trajectories_match(ref, got, 1e-7, "quadratic full batch")
+
+
+def test_batch_mode_rosenbrock_trajectory_matches_reference():
+    """Non-quadratic objective (2-D Rosenbrock embedded in 8-D):
+    batch-mode decisions stay identical (function-value-only search), so
+    f64 trajectories must track the reference step for step —
+    curvature-pair memory, trust-region damping, and the negative-step
+    probe all exercised on a curved landscape."""
+    x0 = np.full((8,), -0.5)
+
+    def torch_loss(xt, i):
+        a, b = xt[0::2], xt[1::2]
+        return ((1.0 - a) ** 2).sum() + 100.0 * ((b - a ** 2) ** 2).sum()
+
+    def jax_loss(x, i):
+        import jax.numpy as jnp
+
+        a, b = x[0::2], x[1::2]
+        return jnp.sum((1.0 - a) ** 2) + 100.0 * jnp.sum((b - a ** 2) ** 2)
+
+    ref = _run_reference(torch_loss, x0, steps=6, **BATCH_KW)
+    got = _run_ours(jax_loss, x0, steps=6, **BATCH_KW)
+    _assert_trajectories_match(ref, got, 1e-8, "Rosenbrock batch mode")
+
+
+def test_batch_mode_changing_batches_match_reference():
+    """Stochastic regime: the objective CHANGES between step() calls
+    (per-step least-squares batches), driving the reference's
+    batch-change detection — running grad mean/variance and the adaptive
+    ``alphabar`` max-step (lbfgsnew.py:600-615) — down the exact same
+    path as ours.  Trajectories must still agree step for step."""
+    dim, nb = 12, 5
+    rng = np.random.default_rng(17)
+    As = rng.normal(size=(nb, 24, dim)) / 4.0
+    bs = rng.normal(size=(nb, 24))
+    x0 = np.zeros((dim,))
+
+    def torch_loss(xt, i):
+        r = torch.tensor(As[i]) @ xt - torch.tensor(bs[i])
+        return 0.5 * (r * r).sum()
+
+    def jax_loss(x, i):
+        import jax.numpy as jnp
+
+        r = As[i] @ x - bs[i]
+        return 0.5 * jnp.sum(r * r)
+
+    ref = _run_reference(torch_loss, x0, steps=nb, **BATCH_KW)
+    got = _run_ours(jax_loss, x0, steps=nb, **BATCH_KW)
+    _assert_trajectories_match(ref, got, 1e-8, "changing batches")
