@@ -1,0 +1,105 @@
+"""Block/layer partition cross-check vs the reference freeze machinery.
+
+The reference trains blockwise by flipping ``requires_grad`` over
+index ranges of the flat ``net.parameters()`` enumeration
+(simple_utils.py:34-45) and exchanging the trainable subset as one
+vector (:47-77).  Our equivalent is static leaf masks over
+``param_order()`` (utils/blocks.py + utils/codec.py).  For EVERY model
+and EVERY block, the per-block trainable size computed by the
+reference's semantics on the ACTUAL torch model must equal our masked
+size — pinning the hand-specified partition tables end to end.
+
+(The reference's ``simple_utils.py`` itself imports torchvision, which
+this environment does not ship; its freeze semantics — indices
+``low..high`` inclusive over ``net.parameters()``, ``2*lid, 2*lid+1``
+for a layer — are replicated inline below, cited line by line.)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from _reference_bootstrap import reference_module
+
+torch, ref_models = reference_module("simple_models")
+
+from federated_pytorch_test_tpu.models import (  # noqa: E402
+    AutoEncoderCNN,
+    AutoEncoderCNNCL,
+    ContextgenCNN,
+    EncoderCNN,
+    Net,
+    Net1,
+    Net2,
+    PredictorCNN,
+    ResNet9,
+    ResNet18,
+)
+from federated_pytorch_test_tpu.utils import blocks as blocklib  # noqa: E402
+from federated_pytorch_test_tpu.utils import codec  # noqa: E402
+
+# (torch model, ours, init sample args)
+_X32 = (jnp.zeros((1, 32, 32, 3)),)
+_LAT = jnp.zeros((1, 2, 2, 64))
+CASES = [
+    ("Net", lambda: ref_models.Net(), Net(), _X32),
+    ("Net1", lambda: ref_models.Net1(), Net1(), _X32),
+    ("Net2", lambda: ref_models.Net2(), Net2(), _X32),
+    ("ResNet9", lambda: ref_models.ResNet9(), ResNet9(), _X32),
+    ("ResNet18", lambda: ref_models.ResNet18(), ResNet18(), _X32),
+    ("AutoEncoderCNN", lambda: ref_models.AutoEncoderCNN(),
+     AutoEncoderCNN(), (jnp.zeros((1, 32, 32, 3)), jax.random.PRNGKey(1))),
+    ("AutoEncoderCNNCL", lambda: ref_models.AutoEncoderCNNCL(K=10, L=32),
+     AutoEncoderCNNCL(K=10, L=32),
+     (jnp.zeros((1, 32, 32, 3)), jax.random.PRNGKey(1))),
+    ("EncoderCNN", lambda: ref_models.EncoderCNN(latent_dim=64),
+     EncoderCNN(latent_dim=64), (jnp.zeros((1, 32, 32, 8)),)),
+    ("ContextgenCNN", lambda: ref_models.ContextgenCNN(latent_dim=64),
+     ContextgenCNN(latent_dim=64), (_LAT,)),
+    ("PredictorCNN", lambda: ref_models.PredictorCNN(latent_dim=64,
+                                                     reduced_dim=16),
+     PredictorCNN(latent_dim=64, reduced_dim=16), (_LAT, _LAT)),
+]
+
+
+@pytest.mark.parametrize("name,tfac,model,sample", CASES,
+                         ids=[c[0] for c in CASES])
+def test_block_partitions_match_reference_freezing(name, tfac, model,
+                                                   sample):
+    tnet = tfac()
+    tsizes = [p.numel() for p in tnet.parameters()]
+    params, _ = model.init_variables(jax.random.PRNGKey(0), *sample)
+    order = model.param_order()
+
+    # layer enumeration parity (number_of_layers, simple_utils.py:79-83)
+    assert len(order) == len(tsizes), (
+        f"{name}: {len(order)} codec leaves vs {len(tsizes)} torch params")
+    # same partition tables on both sides (they are the spec)
+    t_blocks = tnet.train_order_block_ids()
+    assert model.train_order_block_ids() == [list(b) for b in t_blocks]
+
+    for ci, (low, high) in enumerate(t_blocks):
+        # reference semantics: unfreeze_one_block flips requires_grad for
+        # enumeration indices low..high INCLUSIVE (simple_utils.py:34-45)
+        # and get_trainable_values flattens exactly those (:47-66)
+        ref_n = sum(tsizes[low:high + 1])
+        mask = blocklib.build_mask(
+            jax.tree.map(lambda _: 0, params),
+            blocklib.block_paths(order, [low, high]))
+        got_n = codec.masked_size(params, order, mask)
+        assert got_n == ref_n, (
+            f"{name} block {ci} [{low},{high}]: ours {got_n} vs "
+            f"reference {ref_n} trainable values")
+
+    # per-LAYER parity: unfreeze_one_layer(layer_id) -> indices
+    # 2*lid, 2*lid+1 (simple_utils.py:16-22) -- equivalently a [2l, 2l+1]
+    # block; spot-check every even-indexed layer start
+    for lid in range(len(order) // 2):
+        ref_n = sum(tsizes[2 * lid: 2 * lid + 2])
+        mask = blocklib.build_mask(
+            jax.tree.map(lambda _: 0, params),
+            blocklib.layer_paths(order, lid))
+        assert codec.masked_size(params, order, mask) == ref_n, (
+            f"{name} layer {lid}")
