@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 from _reference_bootstrap import reference_module
@@ -76,6 +77,12 @@ def test_block_partitions_match_reference_freezing(name, tfac, model,
     # layer enumeration parity (number_of_layers, simple_utils.py:79-83)
     assert len(order) == len(tsizes), (
         f"{name}: {len(order)} codec leaves vs {len(tsizes)} torch params")
+    # leaf-by-leaf size parity: catches a within-pair permutation (e.g.
+    # bias listed before kernel) that every range SUM below would miss
+    from federated_pytorch_test_tpu.utils.tree import get_by_path
+    ours_sizes = [int(np.prod(get_by_path(params, o).shape))
+                  for o in order]
+    assert ours_sizes == tsizes, f"{name}: per-leaf sizes diverge"
     # same partition tables on both sides (they are the spec)
     t_blocks = tnet.train_order_block_ids()
     assert model.train_order_block_ids() == [list(b) for b in t_blocks]
