@@ -84,6 +84,19 @@ class TestDriverCLI:
             "--no-check-results"])
         assert all("dual_residual" in h for h in hist)
 
+    def test_fedprox_driver_smoke(self, tmp_path, monkeypatch):
+        """FedProx CLI end to end: proximal penalty runs and z is NEVER
+        written back (reference fedprox_multi.py has no
+        put_trainable_values; history carries the primal residual)."""
+        monkeypatch.chdir(tmp_path)
+        from federated_pytorch_test_tpu.drivers.fedprox_multi import main
+        state, hist = main([
+            "--K", "2", "--Nloop", "1", "--Nadmm", "1", "--n-train", "32",
+            "--n-test", "32", "--default-batch", "16", "--no-save-model",
+            "--no-check-results"])
+        assert all("primal_residual" in h for h in hist)
+        assert all(np.isfinite(h["loss"]) for h in hist)
+
     def test_model_flag_resolves_every_choice(self):
         """--model replaces the reference's source-edit model switch
         (federated_multi.py:92-97)."""
