@@ -1,27 +1,32 @@
 #!/bin/bash
 # Poll the axon relay; at the first healthy window run the
 # reference-width CPC AOT probe and a fresh bench (reference CPC width
-# if the probe passed).  DEADLINE: starts no new work after 14:15 UTC so
-# a late recovery cannot contend with the driver's end-of-round bench.
+# if the probe passed).  DEADLINE: no new work STARTS after 14:15 UTC so
+# a late recovery cannot contend with the driver's end-of-round bench
+# (checked before the probe AND again before the bench launch).
 cd /root/repo
 DEADLINE=$(date -u -d "today 14:15" +%s 2>/dev/null || echo 0)
+past_deadline() {
+  [ "$DEADLINE" != 0 ] && [ "$(date -u +%s)" -gt "$DEADLINE" ]
+}
 for i in $(seq 1 90); do
-  now=$(date -u +%s)
-  if [ "$DEADLINE" != 0 ] && [ "$now" -gt "$DEADLINE" ]; then
+  if past_deadline; then
     echo "$(date -u +%H:%M:%S) deadline passed; watcher exiting" >> artifacts/relay_watch.log
     exit 0
   fi
   if timeout 60 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" 2>/dev/null; then
     echo "$(date -u +%H:%M:%S) relay healthy (attempt $i)" >> artifacts/relay_watch.log
     echo "== AOT probe Lc=256" >> artifacts/relay_watch.log
+    CPC_ENV=""
     if PYTHONPATH=/root/repo:/root/.axon_site timeout 1200 python artifacts/probe_cpc_aot.py 256 128 10 encoder 0 >> artifacts/relay_watch.log 2>&1; then
-      echo "== bench at reference CPC width" >> artifacts/relay_watch.log
-      FEDTPU_BENCH_CPC_LC=256 FEDTPU_BENCH_CPC_BATCH=128 \
-        timeout 5400 python bench.py > artifacts/bench_r05_attempt2.out 2> artifacts/bench_r05_attempt2.err
-    else
-      echo "== AOT probe failed/hung; bench at reduced width" >> artifacts/relay_watch.log
-      timeout 5400 python bench.py > artifacts/bench_r05_attempt2.out 2> artifacts/bench_r05_attempt2.err
+      CPC_ENV="FEDTPU_BENCH_CPC_LC=256 FEDTPU_BENCH_CPC_BATCH=128"
     fi
+    if past_deadline; then
+      echo "deadline passed after probe; skipping bench" >> artifacts/relay_watch.log
+      exit 0
+    fi
+    echo "== bench (${CPC_ENV:-reduced width})" >> artifacts/relay_watch.log
+    env $CPC_ENV timeout 5400 python bench.py > artifacts/bench_r05_attempt2.out 2> artifacts/bench_r05_attempt2.err
     echo "bench rc=$?" >> artifacts/relay_watch.log
     exit 0
   fi
