@@ -1,0 +1,21 @@
+"""Lossy update-compression subsystem (see base.py for the contract)."""
+
+from federated_pytorch_test_tpu.compress.base import (
+    COMPRESS_CHOICES,
+    Compressor,
+    make_compressor,
+    stacked_init,
+)
+from federated_pytorch_test_tpu.compress.error_feedback import ErrorFeedback
+from federated_pytorch_test_tpu.compress.quantize import StochasticQuantizer
+from federated_pytorch_test_tpu.compress.topk import TopK
+
+__all__ = [
+    "COMPRESS_CHOICES",
+    "Compressor",
+    "ErrorFeedback",
+    "StochasticQuantizer",
+    "TopK",
+    "make_compressor",
+    "stacked_init",
+]

@@ -1,0 +1,107 @@
+"""Compressor interface for lossy federated-update communication.
+
+The block codec (utils/codec.py) already shrinks each comm round to the
+active block's flat vector — the reference's core bandwidth claim
+(README.md:2).  This subsystem stacks lossy compression of the client
+*update deltas* ``d_k = x_k - z`` on top: the server reconstructs
+``x̂_k = z + decode(encode(d_k))`` and runs the unchanged algorithm
+global update on the reconstructions, so every strategy (FedAvg /
+FedProx / ADMM) is compression-agnostic.  This is the pluggable
+``compressor`` stage FedJAX ships (PAPERS.md: arXiv:2108.02117).
+
+Contract (all implementations):
+
+- ``encode(vec, state) -> (payload, state)`` — jit/vmap-safe; ``vec`` is
+  the f32 flat block vector [n]; ``payload`` is a pytree of fixed-shape
+  arrays (XLA-friendly: shapes depend only on ``n``), ``state`` a
+  per-client pytree (PRNG keys, residuals) threaded round to round.
+- ``decode(payload, n) -> vec`` — the dense f32 [n] reconstruction.
+  ``n`` is the STATIC dense size: fixed-shape payloads cannot carry it
+  (a deliberate deviation from a payload-borne size; k/chunk counts are
+  static for XLA anyway).
+- ``init_state(n, key) -> pytree | None`` — fresh per-client state
+  (``key`` is raw uint32[2] key data, the engine's convention).
+- ``bytes_on_wire(n) -> int`` — exact payload bytes one client ships per
+  round (matches the sum of payload leaf nbytes).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+
+#: CLI surface — drivers/common.py derives --compress choices from this
+#: so the flag and the factory cannot drift.
+COMPRESS_CHOICES = ("none", "q8", "q4", "topk")
+
+
+class Compressor:
+    """Identity compressor — the dense path.  Base class for the rest.
+
+    Note the engine never routes ``--compress none`` through encode/decode
+    at all (the dense comm round stays the literal pre-compression code,
+    bit-identical); Identity exists so benches and tests can treat the
+    settings uniformly.
+    """
+
+    name: str = "none"
+    #: sparse payloads ({"idx","val"}) take the gather-then-scatter
+    #: reduction in parallel/comm.py instead of dense decode-and-sum
+    sparse: bool = False
+
+    def init_state(self, n: int, key) -> Optional[Any]:
+        return None
+
+    def encode(self, vec, state) -> Tuple[Any, Any]:
+        return vec, state
+
+    def decode(self, payload, n: int):
+        return payload
+
+    def bytes_on_wire(self, n: int) -> int:
+        return 4 * n                       # dense f32
+
+
+def make_compressor(name: str, *, topk_frac: float = 0.01,
+                    quant_chunk: int = 256,
+                    error_feedback: bool = False) -> Compressor:
+    """Factory behind ``--compress {none,q8,q4,topk}``."""
+    from federated_pytorch_test_tpu.compress.error_feedback import (
+        ErrorFeedback,
+    )
+    from federated_pytorch_test_tpu.compress.quantize import (
+        StochasticQuantizer,
+    )
+    from federated_pytorch_test_tpu.compress.topk import TopK
+
+    if name not in COMPRESS_CHOICES:
+        raise ValueError(
+            f"unknown compressor {name!r}; expected one of {COMPRESS_CHOICES}")
+    if name == "none":
+        if error_feedback:
+            raise ValueError(
+                "error_feedback requires a lossy compressor "
+                "(--compress q8/q4/topk); the dense path has no residual")
+        return Compressor()
+    inner = {"q8": lambda: StochasticQuantizer(bits=8, chunk=quant_chunk),
+             "q4": lambda: StochasticQuantizer(bits=4, chunk=quant_chunk),
+             "topk": lambda: TopK(frac=topk_frac)}[name]()
+    return ErrorFeedback(inner) if error_feedback else inner
+
+
+def stacked_init(comp: Compressor, K: int, n: int, seed: int):
+    """Host-side [K, ...]-stacked fresh state for all clients (or None).
+
+    Per-client PRNG streams come from splitting one seeded base key —
+    deterministic, so a resumed run that re-inits (fresh block) draws the
+    same stream the original did.
+    """
+    base = jax.random.PRNGKey(seed)
+    keys = np.asarray(jax.random.key_data(jax.random.split(base, K)))
+    per = [comp.init_state(n, keys[k]) for k in range(K)]
+    if per[0] is None:
+        return None
+    return jax.tree.map(lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                        *per)

@@ -1,0 +1,44 @@
+"""Error-feedback wrapper: dropped mass re-enters the next round.
+
+EF-SGD / EF21-style memory: compress ``u = vec + residual`` instead of
+``vec`` and carry ``residual' = u - decode(encode(u))`` in the per-client
+state (it rides in ``ClientState.comp`` next to the inner compressor's
+PRNG key).  For biased compressors (top-k) this is the difference between
+tracking the dense trajectory and drifting — asserted by the convergence
+tests.  The wrapper IS a Compressor, so the engine and the collectives
+treat ``q8`` and ``topk+ef`` identically.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax.numpy as jnp
+
+from federated_pytorch_test_tpu.compress.base import Compressor
+
+
+class ErrorFeedback(Compressor):
+    def __init__(self, inner: Compressor):
+        if inner.name == "none":
+            raise ValueError("error feedback around the identity "
+                             "compressor is a no-op; refuse loudly")
+        self.inner = inner
+        self.name = inner.name + "+ef"
+        self.sparse = inner.sparse
+
+    def init_state(self, n: int, key):
+        return {"inner": self.inner.init_state(n, key),
+                "resid": jnp.zeros((n,), jnp.float32)}
+
+    def encode(self, vec, state) -> Tuple[Any, Any]:
+        u = vec + state["resid"]
+        payload, inner2 = self.inner.encode(u, state["inner"])
+        resid = u - self.inner.decode(payload, u.shape[0])
+        return payload, {"inner": inner2, "resid": resid}
+
+    def decode(self, payload, n: int):
+        return self.inner.decode(payload, n)
+
+    def bytes_on_wire(self, n: int) -> int:
+        return self.inner.bytes_on_wire(n)

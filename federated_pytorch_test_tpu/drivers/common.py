@@ -12,6 +12,7 @@ import dataclasses
 import os
 from typing import Optional
 
+from federated_pytorch_test_tpu.compress import COMPRESS_CHOICES
 from federated_pytorch_test_tpu.data.cifar10 import FederatedCifar10
 from federated_pytorch_test_tpu.models.resnet import ResNet9, ResNet18
 from federated_pytorch_test_tpu.models.simple import Net, Net1, Net2
@@ -42,6 +43,8 @@ def build_parser(defaults: FederatedConfig, prog: str) -> argparse.ArgumentParse
             p.add_argument(arg, choices=("adam", "lbfgs"), default=default)
         elif f.name == "norm":
             p.add_argument(arg, choices=("batch", "group"), default=default)
+        elif f.name == "compress":
+            p.add_argument(arg, choices=COMPRESS_CHOICES, default=default)
         elif f.name == "model":
             p.add_argument(arg, choices=MODEL_CHOICES, default=default)
         elif default is None:

@@ -81,20 +81,27 @@ class TapConv(nn.Module):
     kernel_dilation: Tuple[int, int] = (1, 1)
     padding: Sequence[Tuple[int, int]] = ((0, 0), (0, 0))
     use_bias: bool = True
+    #: mirror nn.Conv's mixed-precision knobs: params are STORED in
+    #: ``param_dtype`` and compute runs in ``dtype`` (None = promote to
+    #: the operands' common dtype) — without these a bf16 model reusing
+    #: TapConv would silently accumulate in a different precision than
+    #: its nn.Conv layers (ADVICE.md item 1)
+    dtype: Optional[jnp.dtype] = None
+    param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         kh, kw = self.kernel_size
         kernel = self.param(
             "kernel", nn.initializers.lecun_normal(),
-            (kh, kw, x.shape[-1], self.features), jnp.float32)
+            (kh, kw, x.shape[-1], self.features), self.param_dtype)
         bias = (self.param("bias", nn.initializers.zeros,
-                           (self.features,), jnp.float32)
+                           (self.features,), self.param_dtype)
                 if self.use_bias else None)
-        # nn.Conv semantics (dtype=None): promote operands to a common
+        # nn.Conv semantics: dtype=None promotes operands to a common
         # dtype rather than downcasting params to x.dtype
         x, kernel, bias = nn.dtypes.promote_dtype(x, kernel, bias,
-                                                  dtype=None)
+                                                  dtype=self.dtype)
         return dilated_conv_taps(
             x, kernel, bias,
             strides=self.strides, dilation=self.kernel_dilation,

@@ -35,6 +35,50 @@ def federated_mean(tree, K: int, axis_name: str = CLIENT_AXIS):
     return jax.tree.map(lambda x: x / K, federated_sum(tree, axis_name))
 
 
+def decode_stack(payloads, compressor, n: int) -> jnp.ndarray:
+    """Dense reconstructions [K_local, n] of a client-stacked payload tree.
+
+    Every payload leaf carries the local client axis in front (the encode
+    side is vmapped the same way), so one vmap of the compressor's decode
+    recovers the per-client dense vectors.
+    """
+    return jax.vmap(lambda p: compressor.decode(p, n))(payloads)
+
+
+def compressed_federated_mean(payloads, compressor, n: int, K: int,
+                              axis_name: str = CLIENT_AXIS, w=None):
+    """Mean over clients of the decoded payloads -> dense [n].
+
+    Two reduction shapes, picked by the payload structure:
+
+    - quantized/dense payloads: decode is fused into the per-device partial
+      sum, so only ONE dense [n] vector per device enters the ``psum``
+      (decode-after-psum: the collective never sees per-client density);
+    - sparse top-k payloads ({"idx","val"}): the local clients' coordinates
+      are scatter-added into a single dense accumulator (gather-then-
+      scatter), then psum'd — the wire stays k-sized per client, the
+      all-reduce stays one dense vector.
+
+    ``w`` ([K_local] activity/weight vector) masks clients out of both the
+    sum and the divisor (partial participation).
+    """
+    if getattr(compressor, "sparse", False):
+        val = payloads["val"]
+        if w is not None:
+            val = val * w[:, None]
+        local = jnp.zeros((n,), val.dtype).at[
+            payloads["idx"].reshape(-1)].add(val.reshape(-1))
+    else:
+        d = decode_stack(payloads, compressor, n)
+        if w is not None:
+            d = d * w[:, None]
+        local = jnp.sum(d, axis=0)
+    total = lax.psum(local, axis_name)
+    if w is None:
+        return total / K
+    return total / lax.psum(jnp.sum(w), axis_name)
+
+
 def all_clients_dot(a: jnp.ndarray, b: jnp.ndarray,
                     axis_name: str = CLIENT_AXIS) -> jnp.ndarray:
     """``sum_k <a_k, b_k>`` summed over ALL clients, for [K_local, N] stacks.

@@ -13,6 +13,7 @@ the same code on a virtual 8-device CPU mesh (tests/conftest.py).
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence
 
 import jax
@@ -20,6 +21,25 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 CLIENT_AXIS = "clients"
+
+# --- shard_map version shim -------------------------------------------------
+# jax >= 0.6 exposes jax.shard_map(..., check_vma=); 0.4.x only has
+# jax.experimental.shard_map.shard_map(..., check_rep=).  Every engine/test
+# call site uses the modern keyword, so translate here instead of scattering
+# try/except over the codebase.
+try:
+    from jax import shard_map as _shard_map_impl  # type: ignore[attr-defined]
+    _REPLICATION_KW = "check_vma"
+except ImportError:                               # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+    _REPLICATION_KW = "check_rep"
+
+
+@functools.wraps(_shard_map_impl)
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs,
+                           **{_REPLICATION_KW: check_vma})
 
 
 def client_mesh(num_devices: Optional[int] = None,

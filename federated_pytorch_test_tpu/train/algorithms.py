@@ -162,9 +162,16 @@ def bb_rho_update(x, z, y, rho, x0, yhat0, bb: BBConfig, mesh_axis_size: int):
     rho values already modified by earlier ones and the final value is the
     last client's decision (consensus_multi.py:248-273).  Here every client
     evaluates with the round-incoming rho in parallel and the globally-last
-    client's (k = K-1) decision is adopted — identical whenever at most one
-    update fires per round, which is the common case (and bb_update defaults
-    to False in the reference, consensus_multi.py:41).
+    client's (k = K-1) decision is adopted — identical to the sequential
+    semantics when no update fires, or when ONLY the last client fires (the
+    common cases; bb_update defaults to False in the reference,
+    consensus_multi.py:41).  When earlier clients fire, the schemes diverge
+    two ways: the last client's accepted candidate is computed from the
+    round-incoming rho rather than the partially-updated one, and an
+    earlier client's lone accepted update is dropped when the last client
+    rejects (the sequential loop would keep it).
+    tests/test_bb_boundary.py characterizes each case against a numpy
+    port of the reference loop.
 
     Returns (rho_new, x0_new, yhat0_new).
     """

@@ -63,6 +63,18 @@ class FederatedConfig:
     # client moves every round).
     participation: float = 1.0
 
+    # lossy update compression (compress/): each comm round the client
+    # ships encode(x_k - z) instead of the dense f32 block vector and the
+    # server averages the reconstructions.  "none" = reference parity
+    # (bit-identical dense path).  q8/q4: stochastic uniform quantization
+    # with per-chunk scales (quant_chunk values per scale); topk: keep the
+    # topk_frac largest-|.| coordinates (pair with error_feedback, which
+    # carries the dropped mass into the next round's update).
+    compress: str = "none"         # none|q8|q4|topk
+    topk_frac: float = 0.01
+    quant_chunk: int = 256
+    error_feedback: bool = False
+
     # adaptive-ADMM Barzilai-Borwein knobs (consensus_multi.py:41-47)
     bb_update: bool = False
     bb_period_T: int = 2

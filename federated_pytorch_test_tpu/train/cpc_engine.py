@@ -19,7 +19,7 @@ from typing import Any, Callable, Dict, List, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from federated_pytorch_test_tpu.data.lofar import CPCDataSource, RoundPrefetcher
@@ -37,6 +37,7 @@ from federated_pytorch_test_tpu.parallel.mesh import (
     fetch,
     local_client_rows,
     replicated_sharding,
+    shard_map,
     stage_client_rows,
     stage_global,
     stage_tree_global,

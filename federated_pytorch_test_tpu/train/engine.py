@@ -31,9 +31,9 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from jax import lax
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from federated_pytorch_test_tpu.compress import make_compressor, stacked_init
 from federated_pytorch_test_tpu.data.cifar10 import FederatedCifar10
 from federated_pytorch_test_tpu.models.base import BlockModule
 from federated_pytorch_test_tpu.optim.lbfgs import LBFGSNew
@@ -43,6 +43,7 @@ from federated_pytorch_test_tpu.parallel.mesh import (
     client_sharding,
     fetch,
     replicated_sharding,
+    shard_map,
     stage_global,
     stage_tree_global,
     usable_device_count,
@@ -61,11 +62,18 @@ from federated_pytorch_test_tpu.utils.profiling import profile_ctx
 
 
 class ClientState(NamedTuple):
-    """Per-client training state, stacked on the leading K axis."""
+    """Per-client training state, stacked on the leading K axis.
+
+    ``comp`` is the update-compression state (compress/base.py): PRNG keys
+    for stochastic quantization and/or error-feedback residuals, threaded
+    through every comm round.  ``None`` on the dense path (--compress none)
+    so the default pytrees — and their compiled programs — are unchanged.
+    """
 
     params: Any
     batch_stats: Any
     opt_state: Any
+    comp: Any = None
 
 
 def _normalize_u8(x_u8: jnp.ndarray, norm: jnp.ndarray) -> jnp.ndarray:
@@ -107,6 +115,12 @@ class BlockwiseFederatedTrainer:
         self.data = data
         self.algo = algorithm
         self.loss_fn = loss_fn
+        # update compression (compress/): validated here so a bad flag
+        # combination fails at construction, not mid-run inside jit
+        self.compressor = make_compressor(
+            cfg.compress, topk_frac=cfg.topk_frac,
+            quant_chunk=cfg.quant_chunk,
+            error_feedback=cfg.error_feedback)
 
         self.order = model.param_order()
         self.block_ids = model.train_order_block_ids()
@@ -374,7 +388,7 @@ class BlockwiseFederatedTrainer:
                 in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0, None, None)
             )(state.params, state.batch_stats, state.opt_state, y, norm, keys,
               xb_u8, yb, wb, z, rho)
-            new = ClientState(p, bs, os)
+            new = ClientState(p, bs, os, state.comp)
             if partial:
                 # inactive clients compute (static shapes on the mesh) but
                 # every result is discarded: params/stats/opt state keep
@@ -383,11 +397,35 @@ class BlockwiseFederatedTrainer:
                 loss = loss * active
             return new, loss
 
+        # compressed exchange (compress/): the LITERAL dense code path is
+        # kept whenever --compress none — encode/decode never enter the
+        # traced program, so the default round stays bit-identical
+        compressor = self.compressor
+        compressed = compressor.name != "none"
+        N = self.block_size(ci) if compressed else None
+
         def comm_shard(state: ClientState, z, y, rho, x0, yhat0, active,
                        mode):
             x = jax.vmap(lambda p: codec.get_trainable_values(p, order, mask))(
                 state.params
             )
+            comp_state = state.comp
+            if compressed:
+                # uplink-compress the update delta d_k = x_k - z; the
+                # "server" sees only x̂_k = z + decode(payload): every
+                # algorithm update below (mean / duals / BB) runs on the
+                # reconstructions, exactly what a wire-compressed
+                # deployment computes
+                from federated_pytorch_test_tpu.parallel.comm import (
+                    decode_stack,
+                )
+                payload, comp_new = jax.vmap(compressor.encode)(
+                    x - z[None, :], comp_state)
+                x = z[None, :] + decode_stack(payload, compressor, N)
+                if partial:
+                    # stragglers' PRNG/residual state stays bit-untouched
+                    comp_new = _sel(active, comp_new, comp_state)
+                comp_state = comp_new
             if mode == "bb_store":        # nadmm == 0 (consensus_multi.py:243-246)
                 x0 = x
             elif mode == "bb":            # nadmm % T == 0 (:247-278)
@@ -410,12 +448,13 @@ class BlockwiseFederatedTrainer:
                 params = _sel(active, wrote, params) if partial else wrote
             if partial:
                 diag["n_active"] = lax.psum(jnp.sum(active), CLIENT_AXIS)
-            return ClientState(params, state.batch_stats, state.opt_state), \
+            return ClientState(params, state.batch_stats, state.opt_state,
+                               comp_state), \
                 znew, ynew, rho, x0, yhat0, diag
 
         spec_c = P(CLIENT_AXIS)
         spec_r = P()
-        state_specs = ClientState(spec_c, spec_c, spec_c)
+        state_specs = ClientState(spec_c, spec_c, spec_c, spec_c)
 
         train_epoch = jax.jit(
             shard_map(
@@ -663,6 +702,30 @@ class BlockwiseFederatedTrainer:
     def init_state(self) -> ClientState:
         return ClientState(self.params0, self.batch_stats0, None)
 
+    def _init_comp_state(self, ci: Optional[int]):
+        """Fresh [K]-stacked compressor state for block ``ci`` (or None).
+
+        Recreated at every block switch like the optimizer state: the
+        residual/PRNG shapes follow the active block's flat size.  Seeded
+        deterministically per (cfg.seed, block), so a resumed run that
+        re-enters a block draws the identical quantization streams.
+        """
+        if self.compressor.name == "none":
+            return None
+        seed = int(np.random.default_rng(
+            [self.cfg.seed, 23, 0 if ci is None else ci]).integers(2**31))
+        host = stacked_init(self.compressor, self.cfg.K,
+                            self.block_size(ci), seed)
+        if host is None:                   # stateless compressor (plain topk)
+            return None
+        return stage_tree_global(host, client_sharding(self.mesh))
+
+    def round_bytes_on_wire(self, N: int, n_active: int) -> int:
+        """Uplink bytes this comm round: every participant ships one
+        encoded block payload (the dense path ships the f32 block — the
+        reference's README.md:2 claim, now measured per round)."""
+        return int(n_active) * int(self.compressor.bytes_on_wire(N))
+
     # ------------------------------------------------------------------
     # mid-run checkpoint / resume (SURVEY.md section 5 "actually resumable
     # mid-run").  The reference can only restart from its end-of-run
@@ -687,6 +750,9 @@ class BlockwiseFederatedTrainer:
             # states as plain dicts, so the structure is rebuilt on restore
             # from a freshly init'd template (leaf order is deterministic)
             tree["opt_state_leaves"] = list(jax.tree.leaves(state.opt_state))
+            comp_leaves = list(jax.tree.leaves(state.comp))
+            if comp_leaves:   # stateful compression: PRNG keys / residuals
+                tree["comp_state_leaves"] = comp_leaves
             tree.update(zip(("z", "y", "rho", "x0", "yhat0"), blockvars))
         meta = {
             "nloop": nloop, "ci": ci, "nadmm": nadmm,
@@ -715,6 +781,7 @@ class BlockwiseFederatedTrainer:
         mid = bool(meta["mid_block"])
         params = put_c(tree["params"])
         opt = None
+        comp = None
         blockvars = None
         if mid:
             _, _, init_opt = self._build_fns(int(meta["ci"]))
@@ -722,10 +789,20 @@ class BlockwiseFederatedTrainer:
             # jitted shard_map init compile + device work at restore time
             opt = put_c(restore_leaves(tree["opt_state_leaves"],
                                        jax.eval_shape(init_opt, params)))
+            if "comp_state_leaves" in tree:
+                # fresh init supplies the structure; saved leaves (PRNG
+                # keys mid-stream, EF residuals) overwrite its values
+                comp = put_c(restore_leaves(
+                    tree["comp_state_leaves"],
+                    self._init_comp_state(int(meta["ci"]))))
+            else:
+                # checkpoint predates compression (or was saved dense):
+                # a stateful compressor starts this block's state fresh
+                comp = self._init_comp_state(int(meta["ci"]))
             blockvars = (put_r(tree["z"]), put_c(tree["y"]),
                          put_r(tree["rho"]), put_c(tree["x0"]),
                          put_c(tree["yhat0"]))
-        state = ClientState(params, put_c(tree["batch_stats"]), opt)
+        state = ClientState(params, put_c(tree["batch_stats"]), opt, comp)
         if "epochs_staged" not in meta:
             raise RuntimeError(
                 "mid-run checkpoint predates the counter-keyed epoch "
@@ -844,7 +921,8 @@ class BlockwiseFederatedTrainer:
                         yhat0 = stage_global(
                             np.zeros((cfg.K, 1), np.float32), csh)
                     state = ClientState(state.params, state.batch_stats,
-                                        init_opt(state.params))
+                                        init_opt(state.params),
+                                        self._init_comp_state(ci))
 
                 for nadmm in range(nadmm_start, cfg.Nadmm):
                     t_round = time.perf_counter()
@@ -901,6 +979,9 @@ class BlockwiseFederatedTrainer:
                                round_seconds=time.perf_counter() - t_round,
                                stage_seconds=stage_s,
                                **diag)
+                    if algo.communicates:
+                        rec["bytes_on_wire"] = self.round_bytes_on_wire(
+                            N, diag.get("n_active", cfg.K))
                     if cfg.check_results:
                         rec["accuracy"] = self.evaluate(state)
                     history.append(rec)

@@ -1,0 +1,156 @@
+"""bb_rho_update multi-client-update boundary (satellite of the compressed
+communication PR; PARITY.md C17's documented deviation, made precise).
+
+The reference's BB loop is SEQUENTIAL over clients: each client evaluates
+its spectral candidate with the rho value already overwritten by earlier
+clients, and the loop's final rho is whatever the chain left behind
+(consensus_multi.py:248-273).  The rebuild evaluates all clients in
+parallel with the round-incoming rho and adopts the globally-last
+client's decision (train/algorithms.py:bb_rho_update).  These tests pin
+down exactly when the two agree and how they diverge, running the
+parallel version inside shard_map on the virtual client mesh against a
+numpy port of the reference loop.
+
+Case construction: with y=0, x0=z=0 and x_k = dx_k, choosing
+yhat0_k = (rho0 - c_k) dx_k makes client k's round-incoming candidate
+exactly c_k (dy = c_k dx => alpha = sign(c_k), alpha_mg = c_k,
+2 alpha_mg > alpha_sd for 0 < c_k < 2, so alphahat = c_k); a negative
+c_k gives alpha = -1 < alphacorrmin, i.e. a rejecting client.  In the
+sequential loop the same construction telescopes:
+rho_k = rho_{k-1} - rho0 + c_k whenever client k accepts.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from federated_pytorch_test_tpu.parallel.mesh import (
+    CLIENT_AXIS,
+    client_mesh,
+    client_sharding,
+    shard_map,
+)
+from federated_pytorch_test_tpu.train.algorithms import BBConfig, bb_rho_update
+
+P = jax.sharding.PartitionSpec
+
+K, N, D = 8, 16, 4
+RHO0 = 0.05
+BB = BBConfig()          # alphacorrmin=0.2, epsilon=1e-3, rhomax=0.1
+
+
+def _make_case(c, seed=0):
+    """(x, z, y, x0, yhat0) with client k's round-incoming BB candidate
+    = c[k] (accepted iff 0 < c[k] < rhomax)."""
+    c = np.asarray(c, np.float64)
+    rng = np.random.default_rng(seed)
+    dx = rng.normal(size=(K, N))
+    # fixed row norm**2 = 10 keeps every d11/d22/|d12| above bb.epsilon
+    dx *= np.sqrt(10.0 / np.sum(dx * dx, axis=1, keepdims=True))
+    z = np.zeros(N)
+    x = dx.copy()
+    x0 = np.zeros((K, N))
+    y = np.zeros((K, N))
+    yhat0 = (RHO0 - c)[:, None] * dx
+    return x, z, y, x0, yhat0
+
+
+def _sequential_reference(x, z, y, rho, x0, yhat0, bb):
+    """Numpy port of the reference's in-place sequential BB loop
+    (consensus_multi.py:248-273): client k sees the rho already
+    overwritten by clients 0..k-1."""
+    rho = float(rho)
+    for k in range(x.shape[0]):
+        yhat = y[k] + rho * (x[k] - z)
+        dy = yhat - yhat0[k]
+        dx = x[k] - x0[k]
+        d11, d12, d22 = dy @ dy, dy @ dx, dx @ dx
+        if not (abs(d12) > bb.epsilon and d11 > bb.epsilon
+                and d22 > bb.epsilon):
+            continue
+        alpha = d12 / np.sqrt(d11 * d22 + 1e-30)
+        alpha_sd = d11 / (d22 + 1e-30)
+        alpha_mg = d12 / (d22 + 1e-30)
+        alphahat = (alpha_mg if 2.0 * alpha_mg > alpha_sd
+                    else alpha_sd - 0.5 * alpha_mg)
+        if alpha >= bb.alphacorrmin and alphahat < bb.rhomax:
+            rho = alphahat
+    return rho
+
+
+def _parallel(x, z, y, rho, x0, yhat0, bb):
+    """bb_rho_update under shard_map: K=8 clients, 2 per device."""
+    mesh = client_mesh(D)
+    csh = client_sharding(mesh)
+    zj = jnp.asarray(z, jnp.float32)
+    rhoj = jnp.float32(rho)
+
+    def f(xs, ys, x0s, yh0s):
+        rho_new, _, _ = bb_rho_update(xs, zj, ys, rhoj, x0s, yh0s, bb, D)
+        return rho_new
+
+    fn = shard_map(f, mesh=mesh, in_specs=(P(CLIENT_AXIS),) * 4,
+                   out_specs=P(), check_vma=False)
+    args = [jax.device_put(jnp.asarray(a, jnp.float32), csh)
+            for a in (x, y, x0, yhat0)]
+    return float(jax.jit(fn)(*args))
+
+
+class TestBBMultiClientBoundary:
+    def test_no_update_fires_agree(self):
+        # every candidate negative -> all reject -> rho unchanged, both
+        c = [-0.05] * K
+        case = _make_case(c)
+        assert _parallel(*case[:2], case[2], RHO0, *case[3:], BB) == \
+            pytest.approx(RHO0, rel=1e-5)
+        assert _sequential_reference(*case[:2], case[2], RHO0, *case[3:],
+                                     BB) == pytest.approx(RHO0, rel=1e-12)
+
+    def test_only_last_client_fires_agree(self):
+        c = [-0.05] * (K - 1) + [0.06]
+        case = _make_case(c)
+        par = _parallel(*case[:2], case[2], RHO0, *case[3:], BB)
+        seq = _sequential_reference(*case[:2], case[2], RHO0, *case[3:], BB)
+        assert par == pytest.approx(0.06, rel=1e-4)
+        assert seq == pytest.approx(par, rel=1e-4)
+
+    def test_single_nonlast_update_is_dropped_by_parallel(self):
+        # DOCUMENTED DIVERGENCE (algorithms.py docstring): one accepted
+        # update at client 1 — the sequential loop keeps it (clients 2..7
+        # then reject because their candidate shifts by rho_cur - rho0),
+        # the parallel scheme adopts the rejecting last client's candidate,
+        # which is the round-incoming rho
+        c = [-0.05, 0.06] + [-0.05] * (K - 2)
+        case = _make_case(c)
+        seq = _sequential_reference(*case[:2], case[2], RHO0, *case[3:], BB)
+        par = _parallel(*case[:2], case[2], RHO0, *case[3:], BB)
+        assert seq == pytest.approx(0.06, rel=1e-12)
+        assert par == pytest.approx(RHO0, rel=1e-5)
+        assert abs(par - seq) > 1e-3
+
+    def test_multi_client_updates_diverge_as_documented(self):
+        # every client accepts with a distinct candidate: the sequential
+        # chain telescopes to sum(c) - (K-1) rho0, the parallel scheme
+        # takes the LAST client's round-incoming candidate c[-1]
+        c = [0.06, 0.05, 0.06, 0.04, 0.05, 0.06, 0.05, 0.04]
+        case = _make_case(c)
+        seq = _sequential_reference(*case[:2], case[2], RHO0, *case[3:], BB)
+        par = _parallel(*case[:2], case[2], RHO0, *case[3:], BB)
+        expect_seq = sum(c) - (K - 1) * RHO0
+        assert seq == pytest.approx(expect_seq, rel=1e-9)
+        assert par == pytest.approx(c[-1], rel=1e-4)
+        # and the two genuinely differ here (0.06 vs 0.04)
+        assert abs(par - seq) > 1e-3
+
+    def test_sequential_chain_really_saw_intermediate_rho(self):
+        # sanity on the reference port itself: re-running it with the
+        # round-incoming rho for every client (the parallel premise)
+        # gives the last candidate instead of the telescoped chain
+        c = [0.06, 0.05, 0.06, 0.04, 0.05, 0.06, 0.05, 0.04]
+        x, z, y, x0, yhat0 = _make_case(c)
+        last_incoming = _sequential_reference(
+            x[-1:], z, y[-1:], RHO0, x0[-1:], yhat0[-1:], BB)
+        assert last_incoming == pytest.approx(c[-1], rel=1e-9)
+        full = _sequential_reference(x, z, y, RHO0, x0, yhat0, BB)
+        assert full != pytest.approx(last_incoming, rel=1e-3)

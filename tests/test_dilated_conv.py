@@ -80,6 +80,43 @@ def test_tapconv_param_tree_matches_nn_conv():
                                rtol=1e-5, atol=1e-5)
 
 
+def test_tapconv_dtype_knobs_mirror_nn_conv():
+    """dtype/param_dtype must behave exactly like nn.Conv's (ADVICE.md
+    item 1): param storage follows param_dtype, compute/output follows
+    dtype (None = promote to the operands' common dtype), and the f32
+    default is unchanged."""
+    rng = np.random.default_rng(13)
+    x32 = jnp.asarray(rng.normal(size=(2, 16, 16, 4)), jnp.float32)
+    kw = dict(features=4, kernel_size=(3, 3), kernel_dilation=(2, 2),
+              padding=((2, 2), (2, 2)))
+
+    # default: f32 params, f32 output — byte-identical to before the knobs
+    p = TapConv(**kw).init(jax.random.PRNGKey(0), x32)["params"]
+    assert p["kernel"].dtype == jnp.float32
+    assert TapConv(**kw).apply({"params": p}, x32).dtype == jnp.float32
+
+    for dtype, param_dtype in ((jnp.bfloat16, jnp.float32),
+                               (jnp.bfloat16, jnp.bfloat16),
+                               (None, jnp.bfloat16)):
+        tap = TapConv(**kw, dtype=dtype, param_dtype=param_dtype)
+        ref = nn.Conv(**kw, dtype=dtype, param_dtype=param_dtype)
+        pt = tap.init(jax.random.PRNGKey(1), x32)["params"]
+        pr = ref.init(jax.random.PRNGKey(1), x32)["params"]
+        assert pt["kernel"].dtype == param_dtype
+        assert pt["bias"].dtype == param_dtype
+        yt = tap.apply({"params": pt}, x32)
+        yr = ref.apply({"params": pr}, x32)
+        assert yt.dtype == yr.dtype       # promotion semantics match
+        np.testing.assert_allclose(
+            np.asarray(yt, np.float32), np.asarray(yr, np.float32),
+            rtol=2e-2, atol=2e-2)         # bf16 accumulation differences
+
+    # bf16 input + f32 params + dtype=None promotes to f32, like nn.Conv
+    xbf = x32.astype(jnp.bfloat16)
+    assert TapConv(**kw).apply({"params": p}, xbf).dtype == \
+        nn.Conv(**kw).apply({"params": p}, xbf).dtype
+
+
 def test_tapconv_grads_match():
     rng = np.random.default_rng(11)
     x = jnp.asarray(rng.normal(size=(2, 32, 32, 8)), jnp.float32)

@@ -135,7 +135,7 @@ class TestAlgorithmAlgebra:
     """Collective algebra checked against closed-form numpy on a tiny mesh."""
 
     def _run_global(self, algo, x, z, y, rho):
-        from jax import shard_map
+        from federated_pytorch_test_tpu.parallel.mesh import shard_map
         from jax.sharding import PartitionSpec as P
 
         mesh = client_mesh(2)
@@ -470,7 +470,7 @@ class TestPartialParticipation:
 
     def test_active_mean_is_mean_over_participants(self, data):
         from federated_pytorch_test_tpu.train.algorithms import FedAvg
-        from jax import shard_map
+        from federated_pytorch_test_tpu.parallel.mesh import shard_map
         from jax.sharding import PartitionSpec as P
 
         mesh = client_mesh(4)

@@ -32,6 +32,7 @@ CYCLE_CRITICAL = [
 ]
 
 LEAF_PACKAGES = [
+    "federated_pytorch_test_tpu.compress",
     "federated_pytorch_test_tpu.data",
     "federated_pytorch_test_tpu.drivers",
     "federated_pytorch_test_tpu.models",

@@ -115,6 +115,54 @@ class TestMidrunResume:
         for a, b in zip(hist_r, hist_full):
             np.testing.assert_allclose(a["loss"], b["loss"], rtol=1e-5)
 
+    @pytest.mark.parametrize("comp_kw", [
+        dict(compress="q8"),
+        dict(compress="topk", topk_frac=0.1, error_feedback=True),
+    ], ids=["q8", "topk_ef"])
+    def test_compressed_state_resumes_identically(self, data, tmp_path,
+                                                  comp_kw):
+        # the per-client compressor state (PRNG key / EF residual) rides
+        # in the midrun checkpoint: a resumed compressed run must replay
+        # the uninterrupted trajectory exactly
+        cfg = small_cfg(**comp_kw)
+        ck = str(tmp_path / "ck")
+        _, hist_full = run_trainer(cfg, data)
+
+        def bomb(state, rec):
+            if rec["nadmm"] == 0:
+                raise Killed
+
+        with pytest.raises(Killed):
+            run_trainer(cfg, data, checkpoint_path=ck, on_round=bomb)
+        state_r, hist_r = run_trainer(cfg, data, checkpoint_path=ck,
+                                      resume=True)
+        assert state_r.comp is not None
+        assert len(hist_r) == len(hist_full)
+        for a, b in zip(hist_r, hist_full):
+            np.testing.assert_allclose(a["loss"], b["loss"], rtol=1e-5)
+            assert a["bytes_on_wire"] == b["bytes_on_wire"]
+
+    def test_pre_compression_checkpoint_resumes_with_fresh_comp(
+            self, data, tmp_path):
+        # a checkpoint written by a DENSE run carries no comp_state_leaves;
+        # resuming it under a compressed config must fall back to fresh
+        # per-client state instead of failing (engine _restore_midrun)
+        ck = str(tmp_path / "ck")
+
+        def bomb(state, rec):
+            if rec["nadmm"] == 0:
+                raise Killed
+
+        with pytest.raises(Killed):
+            run_trainer(small_cfg(), data, checkpoint_path=ck, on_round=bomb)
+        state_r, hist_r = run_trainer(small_cfg(compress="q8"), data,
+                                      checkpoint_path=ck, resume=True)
+        assert state_r.comp is not None
+        assert len(hist_r) == 3                 # Nadmm=3 rounds completed
+        # the continued rounds report the compressed wire size
+        comp_bytes = hist_r[-1]["bytes_on_wire"]
+        assert 0 < comp_bytes < K * 4 * hist_r[-1]["N"]
+
     def test_completed_run_resume_is_noop(self, data, tmp_path):
         cfg = small_cfg(Nadmm=1)
         ck = str(tmp_path / "ck")
@@ -128,7 +176,9 @@ class TestMidrunResume:
 def jax_to_np(tree):
     import jax
 
-    flat, _ = jax.tree.flatten_with_path(tree)
+    # jax.tree_util spelling: jax.tree.flatten_with_path only exists in
+    # newer jax releases than the pinned 0.4.x
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
     return [(jax.tree_util.keystr(p), np.asarray(v)) for p, v in flat]
 
 
