@@ -117,12 +117,15 @@ _PROBE = "import jax; d = jax.devices(); assert d[0].platform == 'tpu', d"
 
 
 def _acquire_backend(attempts: int = 3, probe_timeout: float = 75.0,
-                     backoff: float = 15.0) -> str | None:
+                     backoff: float = 15.0) -> tuple:
     """Probe the TPU backend in a SUBPROCESS (bounded; the axon relay wedge
     hangs the first in-process device query indefinitely, so an in-process
-    try/except cannot implement a retry).  On success return None and leave
-    the environment alone; after ``attempts`` failures force the CPU
-    backend for this process and return the error string.
+    try/except cannot implement a retry).  On success return
+    ``(None, probes_consumed)`` and leave the environment alone; after
+    ``attempts`` failures force the CPU backend for this process and
+    return ``(error_string, attempts)``.  The caller records the probe
+    count in the artifact (``relay_attempts``) so a flaky-but-eventually-
+    healthy relay is visible in the perf record, not just a wedged one.
 
     Defaults bound the worst case at ~4.3 min before the artifact falls
     back to CPU: healthy relay probes connect in ~10-30s, and the caller's
@@ -132,6 +135,7 @@ def _acquire_backend(attempts: int = 3, probe_timeout: float = 75.0,
     the platform via ``jax.config.update``, which only takes effect if it
     lands before backend initialization (importing jax earlier is fine).
     """
+    used = 0
     if os.environ.get("FEDTPU_BENCH_FORCE_CPU") == "1":
         err = "TPU skipped: FEDTPU_BENCH_FORCE_CPU=1"
     else:
@@ -139,12 +143,13 @@ def _acquire_backend(attempts: int = 3, probe_timeout: float = 75.0,
         for attempt in range(attempts):
             if attempt:
                 time.sleep(backoff)
+            used = attempt + 1
             try:
                 r = subprocess.run(
                     [sys.executable, "-c", _PROBE],
                     timeout=probe_timeout, capture_output=True, text=True)
                 if r.returncode == 0:
-                    return None
+                    return None, used
                 last = (r.stderr.strip().splitlines()
                         or ["rc=%d" % r.returncode])[-1]
             except subprocess.TimeoutExpired:
@@ -165,7 +170,7 @@ def _acquire_backend(attempts: int = 3, probe_timeout: float = 75.0,
     # update then only governs later re-initialization) — in the
     # production path nothing has queried devices yet, so it pins CPU
     jax.config.update("jax_platforms", "cpu")
-    return err
+    return err, used
 
 
 def _peak_flops(device) -> float:
@@ -248,7 +253,8 @@ def _bench_round(trainer, ci, *, reps, with_comm=False, with_staging=False):
         diag = None
         if with_comm:
             state, z, y, rho, _, _, diag = comm_fns["plain"](
-                state, z, y, rho, x0, yhat0, trainer._ones_mask)
+                state, z, y, rho, x0, yhat0, trainer._ones_mask,
+                trainer._zero_corrupt, trainer._inf_bound)
         return state, z, y, rho, losses, diag
 
     def sync(losses, diag):
@@ -681,7 +687,7 @@ def main():
         "measured": False,
     }
     # probe BEFORE importing jax (the wedge hangs in-process init)
-    err = _acquire_backend()
+    err, out["relay_attempts"] = _acquire_backend()
     if err is not None:
         out["error"] = err
     try:

@@ -60,6 +60,15 @@ class Compressor:
     def decode(self, payload, n: int):
         return payload
 
+    def reset_state(self, state):
+        """Drop any carried update memory (error-feedback residual) while
+        keeping stream state (PRNG keys).  Called by the engine's update
+        guards when a client is quarantined: the residual was computed
+        from a rejected (possibly non-finite) delta and must not be
+        applied when the client rejoins.  Stateless/memoryless
+        compressors return ``state`` unchanged."""
+        return state
+
     def bytes_on_wire(self, n: int) -> int:
         return 4 * n                       # dense f32
 

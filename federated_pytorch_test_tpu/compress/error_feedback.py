@@ -40,5 +40,16 @@ class ErrorFeedback(Compressor):
     def decode(self, payload, n: int):
         return self.inner.decode(payload, n)
 
+    def reset_state(self, state):
+        """Quarantine policy (train/engine.py update guards): RESET the
+        residual, carry the inner stream state.  The residual of a
+        guard-rejected round was computed from the rejected delta — for a
+        NaN/Inf corruption it IS non-finite — so applying it when the
+        client rejoins would re-inject the poisoned mass the guard just
+        stopped.  The inner state (quantizer PRNG position) carries no
+        update mass and is kept."""
+        return {"inner": self.inner.reset_state(state["inner"]),
+                "resid": jnp.zeros_like(state["resid"])}
+
     def bytes_on_wire(self, n: int) -> int:
         return self.inner.bytes_on_wire(n)

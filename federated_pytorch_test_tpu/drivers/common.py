@@ -14,6 +14,7 @@ from typing import Optional
 
 from federated_pytorch_test_tpu.compress import COMPRESS_CHOICES
 from federated_pytorch_test_tpu.data.cifar10 import FederatedCifar10
+from federated_pytorch_test_tpu.parallel.comm import ROBUST_AGG_CHOICES
 from federated_pytorch_test_tpu.models.resnet import ResNet9, ResNet18
 from federated_pytorch_test_tpu.models.simple import Net, Net1, Net2
 from federated_pytorch_test_tpu.train.algorithms import Algorithm
@@ -45,6 +46,14 @@ def build_parser(defaults: FederatedConfig, prog: str) -> argparse.ArgumentParse
             p.add_argument(arg, choices=("batch", "group"), default=default)
         elif f.name == "compress":
             p.add_argument(arg, choices=COMPRESS_CHOICES, default=default)
+        elif f.name == "robust_agg":
+            p.add_argument(arg, choices=ROBUST_AGG_CHOICES, default=default)
+        elif f.name == "fault_spec":
+            p.add_argument(
+                arg, type=str, default=default, metavar="SPEC",
+                help="fault-injection spec: 'none' or "
+                     "drop=P,straggle=P,corrupt=P,mode=nan|inf|signflip|"
+                     "scale,scale=X,seed=N,clients=i+j (train/faults.py)")
         elif f.name == "model":
             p.add_argument(arg, choices=MODEL_CHOICES, default=default)
         elif default is None:

@@ -14,11 +14,17 @@ bandwidth-reduction claim (README.md:2).
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from federated_pytorch_test_tpu.parallel.mesh import CLIENT_AXIS
+
+#: CLI surface — drivers/common.py derives --robust-agg choices from this
+#: so the flag and the factory cannot drift.
+ROBUST_AGG_CHOICES = ("none", "trim", "median", "clip")
 
 
 def federated_sum(tree, axis_name: str = CLIENT_AXIS):
@@ -77,6 +83,119 @@ def compressed_federated_mean(payloads, compressor, n: int, K: int,
     if w is None:
         return total / K
     return total / lax.psum(jnp.sum(w), axis_name)
+
+
+def robust_federated_mean(x: jnp.ndarray, w=None, *, kind: str,
+                          trim_frac: float = 0.1, clip_mult: float = 3.0,
+                          axis_name: str = CLIENT_AXIS) -> jnp.ndarray:
+    """Byzantine-robust drop-in for the plain ``psum`` mean.
+
+    ``x`` is the client-stacked flat stack ``[K_local, N]`` inside
+    ``shard_map``; the result is the replicated robust aggregate ``[N]``.
+    All three estimators start from a FIXED-SHAPE ``all_gather`` of the
+    client axis (the [K, N] stack lands on every device), so they jit on
+    the virtual mesh and on hardware alike — no data-dependent shapes.
+
+    Kinds and what they tolerate (``m`` = active clients this round):
+
+    - ``trim``: coordinate-wise trimmed mean, dropping the
+      ``t = floor(trim_frac * m)`` largest and smallest values per
+      coordinate.  Breakdown point: up to ``t`` arbitrarily corrupted
+      clients per coordinate, i.e. attacker fraction < ``trim_frac``
+      (and ``trim_frac`` must stay < 1/2 or nothing is left).
+    - ``median``: coordinate-wise median — the ``trim_frac -> 1/2``
+      limit, breakdown point just under ``m/2`` corrupted clients, at
+      the price of higher variance on honest rounds.
+    - ``clip``: norm-clipped mean — every client vector is rescaled to
+      at most ``clip_mult x`` the median active norm, then plainly
+      averaged.  Bounds the damage of a scaled (magnitude) attack to a
+      ``clip_mult``-sized pull; does NOT defend against direction-only
+      attacks (sign flips survive with unit scale).
+
+    Defensive by construction against non-finite updates: a client row
+    containing any NaN/Inf is folded out of the weight vector entirely
+    (it cannot be ranked), so a poisoned update never reaches the sort
+    or the sum.  ``w`` ([K_local] 0/1 activity weights) masks
+    participation the same way; inactive rows are keyed to ``+inf`` and
+    excluded by the dynamic trim window, never multiplied (``0 * inf``
+    would manufacture the NaN this function exists to stop).  An
+    all-rejected round returns the zero vector — the engine's guard
+    layer (train/engine.py) carries ``z`` over in that case.
+    """
+    if kind not in ("trim", "median", "clip"):
+        raise ValueError(f"unknown robust aggregation {kind!r}; expected "
+                         f"one of {ROBUST_AGG_CHOICES[1:]}")
+    xg = lax.all_gather(x, axis_name, tiled=True)            # [K, N]
+    K = xg.shape[0]
+    if w is None:
+        wg = jnp.ones((K,), xg.dtype)
+    else:
+        wg = lax.all_gather(w, axis_name, tiled=True)        # [K]
+    finite = jax.vmap(lambda v: jnp.all(jnp.isfinite(v)))(xg)
+    wg = wg * finite.astype(xg.dtype)
+    m = jnp.sum(wg)                                          # active count
+
+    if kind == "clip":
+        safe = jnp.where(finite[:, None], xg, 0.0)
+        nrm = jax.vmap(jnp.linalg.norm)(safe)
+        c = clip_mult * _masked_median(nrm, wg)
+        scl = jnp.where(nrm > c, c / jnp.maximum(nrm, 1e-30), 1.0)
+        clipped = jnp.where(wg[:, None] > 0, safe * scl[:, None], 0.0)
+        return jnp.sum(clipped, axis=0) / jnp.maximum(m, 1.0)
+
+    # sort-based estimators: key inactive/non-finite rows to +inf so the
+    # active values occupy the first m sorted positions per coordinate
+    key = jnp.where(wg[:, None] > 0, xg, jnp.inf)
+    s = jnp.sort(key, axis=0)                                # [K, N]
+    pos = jnp.arange(K, dtype=xg.dtype)[:, None]
+    if kind == "median":
+        lo = jnp.floor((m - 1.0) / 2.0)
+        hi = jnp.floor(m / 2.0)
+        # & (pos < m): at m == 0 the lo/hi window would otherwise pick
+        # position 0 — a +inf key — instead of the documented zero vector
+        inc = ((pos == lo) | (pos == hi)) & (pos < m)
+    else:                                                    # trim
+        t = jnp.floor(trim_frac * m)
+        inc = (pos >= t) & (pos < m - t)
+    cnt = jnp.sum(inc[:, 0])
+    return (jnp.sum(jnp.where(inc, s, 0.0), axis=0)
+            / jnp.maximum(cnt, 1.0))
+
+
+def _masked_median(v: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """Median of ``v`` [K] over entries with ``w > 0`` (replicated input)."""
+    m = jnp.sum(w)
+    s = jnp.sort(jnp.where(w > 0, v, jnp.inf))
+    pos = jnp.arange(v.shape[0], dtype=v.dtype)
+    lo = jnp.floor((m - 1.0) / 2.0)
+    hi = jnp.floor(m / 2.0)
+    inc = ((pos == lo) | (pos == hi)) & (pos < m)
+    return jnp.sum(jnp.where(inc, s, 0.0)) / jnp.maximum(jnp.sum(inc), 1.0)
+
+
+def make_robust_mean(kind: str, *, trim_frac: float = 0.1,
+                     clip_mult: float = 3.0, axis_name: str = CLIENT_AXIS):
+    """Factory behind ``--robust-agg {none,trim,median,clip}``.
+
+    Returns ``None`` for ``"none"`` (the algorithms then keep their
+    LITERAL plain-mean path — reference parity), else a ``(stack, w) ->
+    aggregate`` callable handed to ``Algorithm.global_update`` as
+    ``mean_fn``.  Validated here so a bad flag fails at trainer
+    construction, not mid-run inside jit.
+    """
+    if kind not in ROBUST_AGG_CHOICES:
+        raise ValueError(f"unknown robust aggregation {kind!r}; expected "
+                         f"one of {ROBUST_AGG_CHOICES}")
+    if kind == "none":
+        return None
+    if not 0.0 <= trim_frac < 0.5:
+        raise ValueError(f"trim_frac={trim_frac} must be in [0, 0.5) "
+                         "(trimming half or more leaves nothing to average)")
+    if clip_mult <= 0.0:
+        raise ValueError(f"clip_mult={clip_mult} must be positive")
+    return functools.partial(robust_federated_mean, kind=kind,
+                             trim_frac=trim_frac, clip_mult=clip_mult,
+                             axis_name=axis_name)
 
 
 def all_clients_dot(a: jnp.ndarray, b: jnp.ndarray,

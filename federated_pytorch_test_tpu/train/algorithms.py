@@ -41,7 +41,11 @@ def _active_mean(x: jnp.ndarray, w, K: int) -> jnp.ndarray:
     if w is None:
         return federated_mean(x, K)
     n_act = lax.psum(jnp.sum(w), CLIENT_AXIS)
-    return federated_sum(w[:, None] * x) / n_act
+    # max(n, 1): an all-rejected guard round (train/engine.py update
+    # guards) has n_act == 0 — return the zero vector instead of 0/0 NaN;
+    # the engine then carries z over.  Unreachable under participation
+    # sampling alone (>= 1 client is always kept).
+    return federated_sum(w[:, None] * x) / jnp.maximum(n_act, 1.0)
 
 
 class Algorithm:
@@ -57,13 +61,24 @@ class Algorithm:
         """Extra per-client local-loss term; x is the client's flat block."""
         return jnp.float32(0.0)
 
-    def global_update(self, x, z, y, rho, K: int, w=None
+    def global_update(self, x, z, y, rho, K: int, w=None, mean_fn=None
                       ) -> Tuple[jnp.ndarray, jnp.ndarray, Dict[str, jnp.ndarray]]:
         """(z_new, y_new, diagnostics) from local stacks x,y [K_local, N].
 
         ``w`` [K_local]: participation weights for this round (1 active,
-        0 inactive); ``None`` = every client (reference parity)."""
+        0 inactive); ``None`` = every client (reference parity).
+        ``mean_fn``: optional ``(stack, w) -> aggregate`` replacing the
+        plain active mean — the robust-aggregation hook
+        (parallel/comm.py ``make_robust_mean``); ``None`` keeps the
+        literal psum-mean path."""
         return z, y, {}
+
+    @staticmethod
+    def _agg(stack, w, K, mean_fn):
+        """The one chokepoint every strategy averages through."""
+        if mean_fn is None:
+            return _active_mean(stack, w, K)
+        return mean_fn(stack, w)
 
 
 class NoConsensus(Algorithm):
@@ -77,8 +92,8 @@ class FedAvg(Algorithm):
     writeback = True
     communicates = True
 
-    def global_update(self, x, z, y, rho, K, w=None):
-        znew = _active_mean(x, w, K)                      # z = sum x_k / K
+    def global_update(self, x, z, y, rho, K, w=None, mean_fn=None):
+        znew = self._agg(x, w, K, mean_fn)                # z = sum x_k / K
         dual = jnp.linalg.norm(z - znew) / x.shape[-1]    # ||z-znew|| / N
         return znew, y, {"dual_residual": dual}
 
@@ -97,8 +112,8 @@ class FedProx(Algorithm):
         d = x - z
         return 0.5 * rho * jnp.vdot(d, d)
 
-    def global_update(self, x, z, y, rho, K, w=None):
-        znew = _active_mean(x, w, K)
+    def global_update(self, x, z, y, rho, K, w=None, mean_fn=None):
+        znew = self._agg(x, w, K, mean_fn)
         n = x.shape[-1]
         dual = jnp.linalg.norm(z - znew) / n
         # primal = sum_k ||rho (x_k - znew)|| / N  (fedprox_multi.py:228-232)
@@ -126,11 +141,11 @@ class AdmmConsensus(Algorithm):
         d = x - z
         return jnp.vdot(y, d) + 0.5 * rho * jnp.vdot(d, d)
 
-    def global_update(self, x, z, y, rho, K, w=None):
+    def global_update(self, x, z, y, rho, K, w=None, mean_fn=None):
         # consensus_multi.py:281-285; under partial participation the
         # average and the dual updates below run over the round's
         # participants only — inactive y_k stay untouched until sampled
-        znew = _active_mean(y + rho * x, w, K) / rho
+        znew = self._agg(y + rho * x, w, K, mean_fn) / rho
         n = x.shape[-1]
         dual = jnp.linalg.norm(z - znew) / n               # :287 (before y update)
         ydelta = rho * (x - znew)                          # :294

@@ -75,6 +75,41 @@ class FederatedConfig:
     quant_chunk: int = 256
     error_feedback: bool = False
 
+    # fault injection (train/faults.py): deterministic, seeded, replayable
+    # per-client per-round faults — dropout, straggler delay (local epochs
+    # withheld, stale update shipped), update corruption (nan/inf/
+    # signflip/scale) at the encode(x_k - z) boundary.  "none" = no
+    # faults (reference parity).  Grammar:
+    #   drop=P,straggle=P,corrupt=P,mode=M,scale=X,seed=N,clients=i+j
+    fault_spec: str = "none"
+
+    # robust aggregation (parallel/comm.py robust_federated_mean):
+    # drop-in alternatives to the plain psum mean — coordinate-wise
+    # trimmed mean ("trim", trims trim_frac per side; tolerates an
+    # attacker fraction < trim_frac), coordinate median ("median",
+    # breakdown ~1/2), norm-clipped mean ("clip", clips every client to
+    # clip_mult x the median active norm).  "none" = the literal dense
+    # psum mean (reference parity).
+    robust_agg: str = "none"       # none|trim|median|clip
+    trim_frac: float = 0.1
+    clip_mult: float = 3.0
+
+    # update guards + quarantine (train/engine.py): validate every
+    # incoming client delta before aggregation — finite, and norm within
+    # guard_norm_mult x the running mean accepted norm (per block; no
+    # norm bound until one clean round has calibrated it).  Offenders are
+    # masked out of the round (partial-participation plumbing) and
+    # quarantined for quarantine_rounds subsequent rounds; an
+    # error-feedback residual of a quarantined client is reset (see
+    # compress/error_feedback.py reset_state).  A round where ALL
+    # clients are rejected degrades gracefully: z carries over, the run
+    # continues.  Off by default: guards add guard_trips/quarantined
+    # history fields, and the default history must stay numerically
+    # identical to the pre-guard dense path.
+    update_guard: bool = False
+    guard_norm_mult: float = 10.0
+    quarantine_rounds: int = 1
+
     # adaptive-ADMM Barzilai-Borwein knobs (consensus_multi.py:41-47)
     bb_update: bool = False
     bb_period_T: int = 2

@@ -343,7 +343,11 @@ class CPCTrainer:
 
     def _run_impl(self, Nloop, Nadmm, state, log, prefetch,
                   checkpoint_path=None, resume=False):
-        from federated_pytorch_test_tpu.utils.checkpoint import newest_slot
+        from federated_pytorch_test_tpu.utils.checkpoint import (
+            CheckpointCorruptError,
+            checkpoint_slots,
+            verify_checkpoint,
+        )
 
         state = state or self.state0
         history: List[Dict[str, Any]] = []
@@ -351,13 +355,27 @@ class CPCTrainer:
         rows = local_client_rows(self.mesh, self.K)
 
         resume_at = r_z = r_opt = None
-        slot = (newest_slot(checkpoint_path)
-                if resume and checkpoint_path is not None else None)
-        if slot is not None:
-            state, r_z, r_opt, resume_at, history = self._restore_midrun(
-                slot)
+        slots = (checkpoint_slots(checkpoint_path)
+                 if resume and checkpoint_path is not None else [])
+        failures = []
+        for slot in slots:
+            try:
+                verify_checkpoint(slot)      # raises on checksum mismatch
+                state, r_z, r_opt, resume_at, history = \
+                    self._restore_midrun(slot)
+            except Exception as e:           # corrupt/truncated slot:
+                failures.append(f"{slot}: {e}")     # fall back, don't die
+                log(f"WARNING: checkpoint slot {slot} is unusable ({e}); "
+                    "falling back to the previous slot")
+                continue
             log(f"resumed mid-run checkpoint {slot} at "
                 f"(nloop, model, block, nadmm)={resume_at[:4]}")
+            break
+        else:
+            if failures:
+                raise CheckpointCorruptError(
+                    "no valid mid-run checkpoint slot survives: "
+                    + "; ".join(failures))
 
         # size the producer by walking the ACTUAL remaining loop structure
         # (not total - len(history): a resume under a different

@@ -54,6 +54,7 @@ from federated_pytorch_test_tpu.train.algorithms import (
     bb_rho_update,
 )
 from federated_pytorch_test_tpu.train.config import FederatedConfig
+from federated_pytorch_test_tpu.train.faults import FaultSpec, apply_corruption
 from federated_pytorch_test_tpu.train.losses import accuracy_count, cross_entropy, l1_l2
 from federated_pytorch_test_tpu.utils import blocks as blocklib
 from federated_pytorch_test_tpu.utils import codec
@@ -121,6 +122,31 @@ class BlockwiseFederatedTrainer:
             cfg.compress, topk_frac=cfg.topk_frac,
             quant_chunk=cfg.quant_chunk,
             error_feedback=cfg.error_feedback)
+        # fault injection + robust aggregation + update guards (the
+        # fault-tolerance layer): all three validate at construction
+        from federated_pytorch_test_tpu.parallel.comm import make_robust_mean
+        self.faults = FaultSpec.parse(cfg.fault_spec)
+        self.mean_fn = make_robust_mean(cfg.robust_agg,
+                                        trim_frac=cfg.trim_frac,
+                                        clip_mult=cfg.clip_mult)
+        if cfg.bb_update and (self.faults.enabled or cfg.update_guard):
+            raise ValueError(
+                "fault injection / update guards are incompatible with "
+                "bb_update: both can mask clients out of a round, and the "
+                "BB spectral history (x0/yhat0 deltas) assumes every "
+                "client moves every round (consensus_multi.py:242-278)")
+        if cfg.quarantine_rounds < 0:
+            raise ValueError(
+                f"quarantine_rounds={cfg.quarantine_rounds} must be >= 0")
+        if cfg.guard_norm_mult <= 0:
+            raise ValueError(
+                f"guard_norm_mult={cfg.guard_norm_mult} must be positive")
+        # host-side fault-tolerance state: per-client remaining quarantine
+        # rounds and the per-block running guard norm scale (inf = not yet
+        # calibrated; no norm bound until one clean round has been seen).
+        # Both ride in the mid-run checkpoint meta so resume replays them.
+        self._quarantine = np.zeros(cfg.K, np.int64)
+        self._guard_scale = float("inf")
 
         self.order = model.param_order()
         self.block_ids = model.train_order_block_ids()
@@ -207,6 +233,12 @@ class BlockwiseFederatedTrainer:
         # take the per-round activity vector unconditionally (uniform
         # shard_map specs); only cfg.participation < 1 ever varies it
         self._ones_mask = stage_global(np.ones(K, np.float32), csh)
+        # fault-layer defaults, staged once: the comm signature always
+        # takes a per-client corruption vector and a replicated guard
+        # bound; on the default path both are these constants and the
+        # traced program never reads them (numerics unchanged)
+        self._zero_corrupt = stage_global(np.zeros(K, np.float32), csh)
+        self._inf_bound = stage_global(np.asarray(np.inf, np.float32), rsh)
 
         # device-resident training data (cfg.device_data; None = auto by
         # size): the raw uint8 shards live in HBM and every epoch's
@@ -371,8 +403,16 @@ class BlockwiseFederatedTrainer:
 
         # partial participation (cfg.participation < 1) is a STATIC mode:
         # the default full-participation build carries no mask plumbing at
-        # all, so the reference-parity path compiles exactly as before
-        partial = cfg.participation < 1.0
+        # all, so the reference-parity path compiles exactly as before.
+        # Fault injection and update guards reuse the same plumbing (a
+        # dropped/quarantined client IS a non-participant), so either
+        # turns the masked mode on too.
+        faults_on = self.faults.enabled
+        guard_on = cfg.update_guard
+        partial = cfg.participation < 1.0 or faults_on or guard_on
+        has_corrupt = faults_on and self.faults.corrupt > 0
+        corrupt_mode, corrupt_scale = self.faults.mode, self.faults.scale
+        mean_fn = self.mean_fn
 
         def _sel(active, new, old):
             """Per-leaf where(active_k, new, old) over the client axis —
@@ -405,10 +445,17 @@ class BlockwiseFederatedTrainer:
         N = self.block_size(ci) if compressed else None
 
         def comm_shard(state: ClientState, z, y, rho, x0, yhat0, active,
-                       mode):
+                       corrupt, gbound, mode):
             x = jax.vmap(lambda p: codec.get_trainable_values(p, order, mask))(
                 state.params
             )
+            if has_corrupt:
+                # fault injection happens at the encode(x_k - z) boundary:
+                # the wire delta is poisoned BEFORE compression, exactly
+                # where a faulty client corrupts a real deployment — the
+                # compressor (and its EF residual) sees the poisoned delta
+                x = z[None, :] + apply_corruption(
+                    x - z[None, :], corrupt, corrupt_mode, corrupt_scale)
             comp_state = state.comp
             if compressed:
                 # uplink-compress the update delta d_k = x_k - z; the
@@ -426,6 +473,34 @@ class BlockwiseFederatedTrainer:
                     # stragglers' PRNG/residual state stays bit-untouched
                     comp_new = _sel(active, comp_new, comp_state)
                 comp_state = comp_new
+            w = active
+            if guard_on:
+                # update guards: every incoming delta must be finite and
+                # within the round's norm bound; offenders are masked out
+                # exactly like non-participants.  NaN hygiene throughout:
+                # where-selects only — 0 * NaN is NaN, masks must never be
+                # multiplied into possibly-corrupt rows.
+                d = x - z[None, :]
+                finite = jax.vmap(lambda v: jnp.all(jnp.isfinite(v)))(d)
+                nrm = jax.vmap(jnp.linalg.norm)(
+                    jnp.where(finite[:, None], d, 0.0))
+                okf = (finite & (nrm <= gbound)).astype(jnp.float32)
+                w = active * okf
+                n_ok = lax.psum(jnp.sum(w), CLIENT_AXIS)
+                n_trip = lax.psum(jnp.sum(active * (1.0 - okf)), CLIENT_AXIS)
+                norm_mean = lax.psum(jnp.sum(w * nrm), CLIENT_AXIS) \
+                    / jnp.maximum(n_ok, 1.0)
+                # rejected rows are neutralised to z so no non-finite value
+                # can reach the aggregation, the BB history, or a psum
+                x = jnp.where(okf[:, None] > 0, x, z[None, :])
+                if compressed and comp_state is not None:
+                    # quarantine/EF interplay: a rejected round's residual
+                    # was computed from the rejected delta (non-finite for
+                    # nan/inf corruption) and must NOT be applied when the
+                    # client rejoins — reset it, keep stream state
+                    rst = jax.vmap(compressor.reset_state)(comp_state)
+                    comp_state = _sel(1.0 - active * (1.0 - okf),
+                                      comp_state, rst)
             if mode == "bb_store":        # nadmm == 0 (consensus_multi.py:243-246)
                 x0 = x
             elif mode == "bb":            # nadmm % T == 0 (:247-278)
@@ -436,7 +511,14 @@ class BlockwiseFederatedTrainer:
                     self.D,
                 )
             znew, ynew, diag = algo.global_update(
-                x, z, y, rho, K, w=active if partial else None)
+                x, z, y, rho, K, w=w if partial else None, mean_fn=mean_fn)
+            if guard_on:
+                # all-rejected round degrades gracefully: z carries over
+                # (ynew is already a no-op — every ydelta is masked by w)
+                znew = jnp.where(n_ok > 0, znew, z)
+                diag["guard_trips"] = n_trip
+                diag["guard_norm_mean"] = norm_mean
+                diag["n_ok"] = n_ok
             params = state.params
             if algo.writeback:
                 wrote = jax.vmap(
@@ -444,13 +526,21 @@ class BlockwiseFederatedTrainer:
                 )(params)
                 # partial FedAvg: only the round's participants receive z;
                 # stragglers stay stale until next sampled (standard
-                # partial-participation semantics)
-                params = _sel(active, wrote, params) if partial else wrote
+                # partial-participation semantics).  Guard-rejected clients
+                # do NOT receive z either (w, not active): the server has
+                # no reason to trust the return channel of a client whose
+                # uplink just failed validation; quarantine keeps them out
+                # until they re-qualify.
+                params = _sel(w, wrote, params) if partial else wrote
             if partial:
                 diag["n_active"] = lax.psum(jnp.sum(active), CLIENT_AXIS)
-            return ClientState(params, state.batch_stats, state.opt_state,
-                               comp_state), \
-                znew, ynew, rho, x0, yhat0, diag
+            out_state = ClientState(params, state.batch_stats,
+                                    state.opt_state, comp_state)
+            if guard_on:
+                # okf rides back to the host so the round loop can
+                # quarantine the offenders it names
+                return (out_state, znew, ynew, rho, x0, yhat0, diag, okf)
+            return out_state, znew, ynew, rho, x0, yhat0, diag
 
         spec_c = P(CLIENT_AXIS)
         spec_r = P()
@@ -467,6 +557,10 @@ class BlockwiseFederatedTrainer:
             )
         )
 
+        comm_out = (state_specs, spec_r, spec_c, spec_r, spec_c,
+                    spec_c, spec_r)
+        if guard_on:
+            comm_out = comm_out + (spec_c,)      # okf verdicts to the host
         comm_fns = {}
         for mode in ("plain", "bb_store", "bb"):
             comm_fns[mode] = jax.jit(
@@ -474,9 +568,8 @@ class BlockwiseFederatedTrainer:
                     functools.partial(comm_shard, mode=mode),
                     mesh=self.mesh,
                     in_specs=(state_specs, spec_r, spec_c, spec_r, spec_c,
-                              spec_c, spec_c),
-                    out_specs=(state_specs, spec_r, spec_c, spec_r, spec_c,
-                               spec_c, spec_r),
+                              spec_c, spec_c, spec_c, spec_r),
+                    out_specs=comm_out,
                     check_vma=False,
                 )
             )
@@ -591,24 +684,92 @@ class BlockwiseFederatedTrainer:
         expensive part of staging, safe to run on the worker thread."""
         return self.data.epoch_batches_raw(self._epoch_seed(counter, 0))
 
-    def _round_mask(self, nloop: int, ci: int, nadmm: int):
-        """[K] f32 activity mask for this communication round.
-
-        Full participation (the default, reference parity) returns the
-        staged ones mask.  Under ``cfg.participation < 1`` every client is
-        sampled independently per round — STATELESSLY keyed on the round
-        coordinates, so a resumed run redraws the identical masks — with
-        at least one participant guaranteed.
-        """
-        if self.cfg.participation >= 1.0:
-            return self._ones_mask
+    def _participation_host(self, nloop: int, ci: int, nadmm: int):
+        """Host [K] f32 participation draw for this round — STATELESSLY
+        keyed on the round coordinates, so a resumed run redraws the
+        identical masks — with at least one participant guaranteed."""
         rng = np.random.default_rng(
             [self.cfg.seed, 11, nloop, ci, nadmm])
         m = (rng.random(self.cfg.K)
              < self.cfg.participation).astype(np.float32)
         if not m.any():
             m[int(rng.integers(self.cfg.K))] = 1.0
-        return stage_global(m, client_sharding(self.mesh))
+        return m
+
+    def _round_mask(self, nloop: int, ci: int, nadmm: int):
+        """[K] f32 activity mask for this communication round.
+
+        Full participation (the default, reference parity) returns the
+        staged ones mask; under ``cfg.participation < 1`` the stateless
+        per-round draw (``_participation_host``).
+        """
+        if self.cfg.participation >= 1.0:
+            return self._ones_mask
+        return stage_global(self._participation_host(nloop, ci, nadmm),
+                            client_sharding(self.mesh))
+
+    def _round_activity(self, nloop: int, ci: int, nadmm: int):
+        """Compose participation sampling x quarantine x injected faults
+        into this round's activity masks.
+
+        Returns ``(train, comm, corrupt, comm_host, counts)``:
+
+        - ``train``  [K] staged: clients that run local epochs this round
+          (stragglers are in ``comm`` but not here — they ship their
+          round-start params, i.e. the promised update is withheld);
+        - ``comm``   [K] staged: clients in the exchange (dropped and
+          quarantined clients are out of BOTH — exactly the established
+          non-participant semantics);
+        - ``corrupt`` [K] staged: 1 where the shipped delta is poisoned
+          (only ever a subset of ``comm``);
+        - ``comm_host``: the host copy of ``comm`` (the guard's
+          quarantine bookkeeping needs it to tell "active and rejected"
+          from "never participated");
+        - ``counts``: host ints for the history record (``n_comm`` plus
+          ``fault_*`` when injection is live; empty on the fast path).
+
+        The fast path (no faults, nothing quarantined) returns the staged
+        participation mask untouched — the reference-parity round stages
+        the exact arrays it always did.
+        """
+        cfg, faults = self.cfg, self.faults
+        quarantined = int(np.sum(self._quarantine > 0))
+        if not faults.enabled and quarantined == 0:
+            if cfg.participation >= 1.0:
+                dev, host = self._ones_mask, np.ones(cfg.K, np.float32)
+            else:
+                host = self._participation_host(nloop, ci, nadmm)
+                dev = stage_global(host, client_sharding(self.mesh))
+            return dev, dev, self._zero_corrupt, host, {}
+        base = (np.ones(cfg.K, np.float32) if cfg.participation >= 1.0
+                else self._participation_host(nloop, ci, nadmm))
+        ok = 1.0 - (self._quarantine > 0).astype(np.float32)
+        drop = straggle = corrupt = np.zeros(cfg.K, np.float32)
+        if faults.enabled:
+            drop, straggle, corrupt = faults.round_faults(
+                cfg.K, nloop, ci, nadmm)
+        comm = base * ok * (1.0 - drop)
+        train = comm * (1.0 - straggle)
+        corrupt = corrupt * comm
+        counts = {"n_comm": int(comm.sum())}
+        if faults.enabled:
+            counts.update(
+                fault_dropped=int(np.sum(base * ok * drop)),
+                fault_straggled=int(np.sum(comm * straggle)),
+                fault_corrupted=int(np.sum(corrupt)))
+        csh = client_sharding(self.mesh)
+        return (stage_global(train, csh), stage_global(comm, csh),
+                stage_global(corrupt, csh), comm, counts)
+
+    def _round_gbound(self):
+        """Staged replicated norm bound for the update guard: no bound
+        (+inf) until one accepted round has calibrated the running scale
+        — a fresh block's deltas have no reference magnitude yet."""
+        if not (self.cfg.update_guard and np.isfinite(self._guard_scale)):
+            return self._inf_bound
+        return stage_global(
+            np.asarray(self.cfg.guard_norm_mult * self._guard_scale,
+                       np.float32), replicated_sharding(self.mesh))
 
     def _want_device_data(self) -> bool:
         want = self.cfg.device_data
@@ -764,6 +925,12 @@ class BlockwiseFederatedTrainer:
             "keys_staged": self._keys_staged,
             "history": pack_history(history),
         }
+        if self.cfg.update_guard:
+            # guard state is host state: pending quarantine sentences and
+            # the calibrated norm scale must survive a kill, or a resumed
+            # run would readmit an offender early / drop the bound
+            meta["quarantine"] = np.asarray(self._quarantine, np.int64)
+            meta["guard_scale"] = np.asarray(self._guard_scale, np.float64)
         save_checkpoint_swapped(path, tree, meta)
 
     def _restore_midrun(self, path):
@@ -811,6 +978,13 @@ class BlockwiseFederatedTrainer:
                 "end-of-run checkpoint instead")
         self._epochs_staged = int(meta["epochs_staged"])
         self._keys_staged = int(meta["keys_staged"])
+        if self.cfg.update_guard:
+            if "quarantine" in meta:
+                self._quarantine = np.asarray(meta["quarantine"], np.int64)
+                self._guard_scale = float(meta["guard_scale"])
+            else:           # checkpoint predates the guards: start clean
+                self._quarantine = np.zeros(self.cfg.K, np.int64)
+                self._guard_scale = float("inf")
         # a pending prefetched epoch stays valid across restore IF its
         # counter matches (epochs are pure functions of the counter);
         # _stage_epoch's counter check handles both cases
@@ -877,16 +1051,34 @@ class BlockwiseFederatedTrainer:
         csh = client_sharding(self.mesh)
         rsh = replicated_sharding(self.mesh)
 
-        from federated_pytorch_test_tpu.utils.checkpoint import newest_slot
+        from federated_pytorch_test_tpu.utils.checkpoint import (
+            CheckpointCorruptError,
+            checkpoint_slots,
+            verify_checkpoint,
+        )
 
         resume_at = None
-        slot = (newest_slot(checkpoint_path)
-                if resume and checkpoint_path is not None else None)
-        if slot is not None:
-            state, r_blockvars, resume_at, history = self._restore_midrun(
-                slot)
+        slots = (checkpoint_slots(checkpoint_path)
+                 if resume and checkpoint_path is not None else [])
+        failures = []
+        for slot in slots:
+            try:
+                verify_checkpoint(slot)      # raises on checksum mismatch
+                state, r_blockvars, resume_at, history = \
+                    self._restore_midrun(slot)
+            except Exception as e:           # corrupt/truncated slot:
+                failures.append(f"{slot}: {e}")     # fall back, don't die
+                log(f"WARNING: checkpoint slot {slot} is unusable ({e}); "
+                    "falling back to the previous slot")
+                continue
             log(f"resumed mid-run checkpoint {slot} at "
                 f"(nloop, block, nadmm)={resume_at[:3]}")
+            break
+        else:
+            if failures:
+                raise CheckpointCorruptError(
+                    "no valid mid-run checkpoint slot survives: "
+                    + "; ".join(failures))
 
         for nloop in range(cfg.Nloop):
             for ci in range(self.L):
@@ -923,10 +1115,17 @@ class BlockwiseFederatedTrainer:
                     state = ClientState(state.params, state.batch_stats,
                                         init_opt(state.params),
                                         self._init_comp_state(ci))
+                    # fresh block => fresh delta scale: the guard norm
+                    # bound recalibrates (no bound until one clean round)
+                    self._guard_scale = float("inf")
 
                 for nadmm in range(nadmm_start, cfg.Nadmm):
                     t_round = time.perf_counter()
-                    active = self._round_mask(nloop, ci, nadmm)
+                    active, comm_active, corrupt, comm_host, fcounts = \
+                        self._round_activity(nloop, ci, nadmm)
+                    n_comm = fcounts.pop("n_comm", 1)
+                    q_start = (int(np.sum(self._quarantine > 0))
+                               if cfg.update_guard else 0)
                     loss_acc = None       # on-device [K] accumulator: the
                     stage_s = 0.0         # host fetch happens ONCE per round
                     for nepoch in range(cfg.Nepoch):
@@ -952,7 +1151,7 @@ class BlockwiseFederatedTrainer:
                                 f"epoch={nepoch} client_loss="
                                 + np.array2string(fetch(losses),
                                                   precision=4))
-                    if algo.communicates:
+                    if algo.communicates and n_comm > 0:
                         if cfg.bb_update and nadmm == 0:
                             mode = "bb_store"
                         elif (cfg.bb_update and nadmm > 0
@@ -960,9 +1159,44 @@ class BlockwiseFederatedTrainer:
                             mode = "bb"
                         else:
                             mode = "plain"
-                        state, z, y, rho, x0, yhat0, diag = comm_fns[mode](
-                            state, z, y, rho, x0, yhat0, active)
+                        out = comm_fns[mode](
+                            state, z, y, rho, x0, yhat0, comm_active,
+                            corrupt, self._round_gbound())
+                        if cfg.update_guard:
+                            state, z, y, rho, x0, yhat0, diag, okf = out
+                        else:
+                            state, z, y, rho, x0, yhat0, diag = out
                         diag = {k: float(v) for k, v in diag.items()}
+                        if cfg.update_guard:
+                            # quarantine this round's offenders (active AND
+                            # rejected — okf alone cannot tell a rejected
+                            # client from one that never participated),
+                            # tick running sentences down one round, and
+                            # fold the accepted delta-norm scale into the
+                            # guard bound (EMA; first clean round seeds it)
+                            okf_h = np.asarray(fetch(okf))
+                            tripped = (comm_host > 0) & (okf_h < 0.5)
+                            self._quarantine = np.maximum(
+                                self._quarantine - 1, 0)
+                            if cfg.quarantine_rounds > 0:
+                                self._quarantine[tripped] = \
+                                    cfg.quarantine_rounds
+                            if diag.get("n_ok", 0.0) > 0:
+                                nm = diag["guard_norm_mean"]
+                                self._guard_scale = (
+                                    nm
+                                    if not np.isfinite(self._guard_scale)
+                                    else 0.5 * self._guard_scale + 0.5 * nm)
+                    elif algo.communicates:
+                        # every client dropped/quarantined out of the
+                        # exchange: degrade gracefully — no collective runs,
+                        # z/y/rho carry over unchanged and the round is
+                        # still recorded (and still serves quarantine time)
+                        diag = {"n_active": 0.0}
+                        if cfg.update_guard:
+                            diag.update(guard_trips=0.0, n_ok=0.0)
+                            self._quarantine = np.maximum(
+                                self._quarantine - 1, 0)
                     else:
                         diag = {}
                     # single host sync per round: the loss fetch depends on
@@ -978,7 +1212,12 @@ class BlockwiseFederatedTrainer:
                                loss=loss_sum, rho=float(rho),
                                round_seconds=time.perf_counter() - t_round,
                                stage_seconds=stage_s,
-                               **diag)
+                               **fcounts, **diag)
+                    if cfg.update_guard and algo.communicates:
+                        # quarantine census at round START (who sat this
+                        # round out), next to the guard_trips the round
+                        # itself produced
+                        rec["quarantined"] = q_start
                     if algo.communicates:
                         rec["bytes_on_wire"] = self.round_bytes_on_wire(
                             N, diag.get("n_active", cfg.K))

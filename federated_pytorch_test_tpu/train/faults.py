@@ -1,0 +1,175 @@
+"""Deterministic fault-injection harness for federated rounds.
+
+The reference runs all K clients sequentially in one process, so a client
+can never fail; production federations lose clients mid-round, see
+stragglers ship stale work, and receive non-finite or adversarially
+scaled updates.  FL_PyTorch (arXiv:2202.03099) and FedJAX
+(arXiv:2108.02117) both treat simulated client failure as a first-class
+simulator feature; this module is that feature for the engine.
+
+Faults are injected at two boundaries, both already present in the
+round:
+
+* **dropout / straggle** fold into the partial-participation activity
+  masks (train/engine.py ``_round_activity``): a dropped client neither
+  trains nor exchanges this round (exactly the ``participation < 1``
+  semantics); a straggler's local epochs are withheld (its training
+  results are discarded) but it still joins the exchange with its
+  round-start parameters — a stale update.
+* **corruption** hits the update delta ``d_k = x_k - z`` at the
+  ``encode`` boundary (:func:`apply_corruption` inside the comm round),
+  BEFORE compression — so faults compose with the ``compress/`` package
+  the way a corrupted wire payload would.
+
+The schedule is a pure function of ``(spec.seed, nloop, block, nadmm,
+client)`` — no host RNG state — so the same ``--fault-spec`` replays
+bit-identically across runs AND across a mid-run checkpoint resume
+(the same statelessness argument as the participation masks,
+engine ``_round_mask``).
+
+Spec grammar (``--fault-spec``)::
+
+    none
+    drop=P,straggle=P,corrupt=P,mode=M,scale=X,seed=N,clients=i+j+k
+
+``P`` are independent per-client per-round probabilities; ``mode`` is
+one of ``nan | inf | signflip | scale`` (default ``scale``); ``scale``
+is the multiplier for ``mode=scale`` (default 100); ``clients``
+restricts fault eligibility to the listed client indices (default: all
+— ``clients=0`` with ``corrupt=1`` is the classic "one Byzantine
+client" adversary).  Precedence per client per round: drop beats
+straggle beats corrupt (a dead client cannot also send garbage).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+CORRUPT_MODES = ("nan", "inf", "signflip", "scale")
+
+
+class RoundFaults(NamedTuple):
+    """Per-client 0/1 fault indicators for one communication round."""
+
+    drop: np.ndarray        # [K] f32 — client lost for the round
+    straggle: np.ndarray    # [K] f32 — local epochs withheld, stale update
+    corrupt: np.ndarray     # [K] f32 — update delta corrupted on the wire
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """Parsed ``--fault-spec`` (see module docstring for the grammar)."""
+
+    drop: float = 0.0
+    straggle: float = 0.0
+    corrupt: float = 0.0
+    mode: str = "scale"
+    scale: float = 100.0
+    seed: int = 0
+    clients: Optional[Tuple[int, ...]] = None   # None = every client eligible
+
+    @property
+    def enabled(self) -> bool:
+        return self.drop > 0 or self.straggle > 0 or self.corrupt > 0
+
+    @property
+    def masking(self) -> bool:
+        """Does this spec ever change the round activity masks?"""
+        return self.drop > 0 or self.straggle > 0
+
+    @classmethod
+    def parse(cls, spec: Optional[str]) -> "FaultSpec":
+        """``"none"``/empty/None -> the disabled spec; else key=value CSV."""
+        if spec is None or spec.strip() in ("", "none"):
+            return cls()
+        kw: dict = {}
+        for item in spec.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            if "=" not in item:
+                raise ValueError(
+                    f"fault-spec item {item!r} is not key=value "
+                    "(grammar: drop=P,straggle=P,corrupt=P,mode=M,"
+                    "scale=X,seed=N,clients=i+j)")
+            key, val = (s.strip() for s in item.split("=", 1))
+            if key in ("drop", "straggle", "corrupt"):
+                p = float(val)
+                if not 0.0 <= p <= 1.0:
+                    raise ValueError(f"fault-spec {key}={p} outside [0, 1]")
+                kw[key] = p
+            elif key == "mode":
+                if val not in CORRUPT_MODES:
+                    raise ValueError(f"fault-spec mode={val!r}; expected one "
+                                     f"of {CORRUPT_MODES}")
+                kw[key] = val
+            elif key == "scale":
+                kw[key] = float(val)
+            elif key == "seed":
+                kw[key] = int(val)
+            elif key == "clients":
+                idx = tuple(int(s) for s in val.split("+") if s != "")
+                if not idx or any(i < 0 for i in idx):
+                    raise ValueError(
+                        f"fault-spec clients={val!r}: need non-negative "
+                        "indices joined by '+'")
+                kw[key] = idx
+            else:
+                raise ValueError(f"unknown fault-spec key {key!r}")
+        out = cls(**kw)
+        if not out.enabled:
+            raise ValueError(
+                f"fault-spec {spec!r} names no fault probability "
+                "(set drop/straggle/corrupt, or pass 'none')")
+        return out
+
+    def round_faults(self, K: int, nloop: int, ci: int, nadmm: int
+                     ) -> RoundFaults:
+        """The [K] fault indicators for round ``(nloop, ci, nadmm)``.
+
+        Stateless in the round coordinates (same recipe as the engine's
+        participation masks) so runs and resumed runs draw the identical
+        schedule; the ``47`` tag keeps the stream disjoint from the
+        participation (11) and compressor (23) streams.
+        """
+        if self.clients is not None and any(i >= K for i in self.clients):
+            raise ValueError(
+                f"fault-spec clients={self.clients} out of range for K={K}")
+        rng = np.random.default_rng([self.seed, 47, nloop, ci, nadmm])
+        u = rng.random((3, K))
+        eligible = np.zeros(K, np.float32)
+        if self.clients is None:
+            eligible[:] = 1.0
+        else:
+            eligible[list(self.clients)] = 1.0
+        drop = (u[0] < self.drop).astype(np.float32) * eligible
+        straggle = ((u[1] < self.straggle).astype(np.float32)
+                    * eligible * (1.0 - drop))
+        corrupt = ((u[2] < self.corrupt).astype(np.float32)
+                   * eligible * (1.0 - drop) * (1.0 - straggle))
+        return RoundFaults(drop, straggle, corrupt)
+
+
+def apply_corruption(delta: jnp.ndarray, corrupt: jnp.ndarray, mode: str,
+                     scale: float) -> jnp.ndarray:
+    """Corrupt the client-stacked update deltas ``[K_local, N]``.
+
+    ``corrupt`` is the per-client 0/1 indicator ``[K_local]``; ``mode``
+    and ``scale`` are static (one compiled program per spec).  Uses
+    elementwise selects, never masked arithmetic, so a NaN/Inf payload
+    cannot leak into the untouched clients' rows.
+    """
+    c = corrupt.reshape((-1,) + (1,) * (delta.ndim - 1)) > 0
+    if mode == "nan":
+        return jnp.where(c, jnp.full_like(delta, jnp.nan), delta)
+    if mode == "inf":
+        return jnp.where(c, jnp.full_like(delta, jnp.inf), delta)
+    if mode == "signflip":
+        return jnp.where(c, -delta, delta)
+    if mode == "scale":
+        return jnp.where(c, scale * delta, delta)
+    raise ValueError(f"unknown corruption mode {mode!r}")

@@ -17,16 +17,81 @@ separate torch files.
 
 from __future__ import annotations
 
+import hashlib
 import os
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+#: content-checksum sidecar written INSIDE each checkpoint directory
+#: (rides along with the slot renames for free).  Orbax restore walks its
+#: own manifest, not the directory listing, so the extra file is inert.
+CHECKSUM_FILE = "fedtpu.sha256"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint directory exists but fails validation (checksum
+    mismatch / unreadable): truncated write, bit-rot, or tampering."""
+
 
 def _abspath(path: str) -> str:
     return os.path.abspath(os.path.expanduser(path))
+
+
+def _dir_checksum(path: str) -> str:
+    """sha256 over every file in the checkpoint dir (sorted relpath +
+    content), excluding the checksum sidecar itself."""
+    h = hashlib.sha256()
+    root = _abspath(path)
+    for dirpath, dirnames, filenames in sorted(os.walk(root)):
+        dirnames.sort()
+        for fn in sorted(filenames):
+            if fn == CHECKSUM_FILE or fn.endswith(".tmp"):
+                continue
+            full = os.path.join(dirpath, fn)
+            h.update(os.path.relpath(full, root).encode())
+            h.update(b"\0")
+            with open(full, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+            h.update(b"\0")
+    return h.hexdigest()
+
+
+def write_checksum(path: str) -> None:
+    """Embed the content checksum in a finalized checkpoint dir.
+
+    Atomic (temp file + ``os.replace``): a kill mid-write leaves either no
+    sidecar (checkpoint merely unverified, still loadable) or a complete
+    one — never a truncated checksum that would condemn a good checkpoint.
+    """
+    target = os.path.join(_abspath(path), CHECKSUM_FILE)
+    tmp = target + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(_dir_checksum(path) + "\n")
+    os.replace(tmp, target)
+
+
+def verify_checkpoint(path: str) -> bool:
+    """Validate ``path`` against its embedded checksum.
+
+    Returns True (verified) or False (pre-checksum checkpoint: no sidecar
+    to verify against — old checkpoints stay loadable).  Raises
+    :class:`CheckpointCorruptError` on a mismatch.
+    """
+    target = os.path.join(_abspath(path), CHECKSUM_FILE)
+    if not os.path.isfile(target):
+        return False
+    with open(target) as f:
+        want = f.read().strip()
+    got = _dir_checksum(path)
+    if got != want:
+        raise CheckpointCorruptError(
+            f"checkpoint {path} failed its content checksum (stored "
+            f"{want[:12]}.., recomputed {got[:12]}..): truncated or corrupt")
+    return True
 
 
 def save_checkpoint(path: str, state, meta: Optional[Dict[str, Any]] = None) -> None:
@@ -35,6 +100,10 @@ def save_checkpoint(path: str, state, meta: Optional[Dict[str, Any]] = None) -> 
     tree = {"state": state,
             "meta": {k: np.asarray(v) for k, v in (meta or {}).items()}}
     ckptr.save(_abspath(path), tree, force=True)
+    # ckptr.save is collective and returns only after orbax finalizes the
+    # directory, so the primary hashes a complete checkpoint
+    if _is_primary():
+        write_checksum(path)
 
 
 def newest_slot(path: str) -> Optional[str]:
@@ -49,15 +118,25 @@ def newest_slot(path: str) -> Optional[str]:
     Slots are probed NEWEST-first — the ordering is static, not mtime-based,
     because the swap protocol fixes the age relation: ``path.next`` only
     survives a crash that hit after its save completed but before the swap,
-    so when present it is always the newest; ``path.old`` only exists
-    mid-swap and is always the oldest.  (Probing ``path`` first would
-    silently resume a round-stale primary and let the next swap's rmtree
-    delete the newer ``.next``.)
+    so when present it is always the newest; ``path.old`` is the previous
+    round's checkpoint, retained at rest as the restore fallback and always
+    the oldest.  (Probing ``path`` first would silently resume a
+    round-stale primary and let the next swap's rmtree delete the newer
+    ``.next``.)
     """
-    for cand in (path + ".next", path, path + ".old"):
-        if os.path.isdir(_abspath(cand)):
-            return cand
-    return None
+    slots = checkpoint_slots(path)
+    return slots[0] if slots else None
+
+
+def checkpoint_slots(path: str) -> List[str]:
+    """All on-disk swap slots for ``path``, NEWEST first (see
+    :func:`newest_slot` for why the static order is the age order).
+
+    Restore-with-fallback walks this list: a slot that fails its checksum
+    or its orbax restore is skipped (with a warning) and the next-older
+    complete checkpoint is used instead of crashing the run."""
+    return [cand for cand in (path + ".next", path, path + ".old")
+            if os.path.isdir(_abspath(cand))]
 
 
 def _is_primary() -> bool:
@@ -135,7 +214,10 @@ def save_checkpoint_swapped(path: str, tree,
         if os.path.isdir(_abspath(path)):
             os.rename(_abspath(path), _abspath(old_path))
         os.rename(_abspath(nxt_path), _abspath(path))
-        shutil.rmtree(_abspath(old_path), ignore_errors=True)
+        # ``path.old`` (the previous round) is RETAINED: it is the restore
+        # fallback when the primary later fails its content checksum
+        # (bit-rot, truncation) — see checkpoint_slots / verify_checkpoint.
+        # Costs one extra checkpoint of disk, bounded at one slot.
     _barrier("fedtpu:ckpt:swapped")
 
 

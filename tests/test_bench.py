@@ -26,7 +26,7 @@ class TestAcquireBackend:
                             lambda *a, **kw: calls.append(a) or R())
         monkeypatch.delenv("FEDTPU_BENCH_FORCE_CPU", raising=False)
         before = os.environ.get("JAX_PLATFORMS")
-        assert bench._acquire_backend() is None
+        assert bench._acquire_backend() == (None, 1)
         assert len(calls) == 1
         assert os.environ.get("JAX_PLATFORMS") == before
 
@@ -44,9 +44,10 @@ class TestAcquireBackend:
         monkeypatch.delenv("FEDTPU_BENCH_FORCE_CPU", raising=False)
         monkeypatch.setenv("JAX_PLATFORMS", "tpu")          # restored after
         monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "1.2.3.4")
-        err = bench._acquire_backend(attempts=3, probe_timeout=0.5,
-                                     backoff=7.0)
+        err, used = bench._acquire_backend(attempts=3, probe_timeout=0.5,
+                                           backoff=7.0)
         assert "after 3 probes" in err and "hung" in err
+        assert used == 3                     # every probe consumed, recorded
         assert sleeps == [7.0, 7.0]                         # between probes
         assert os.environ["JAX_PLATFORMS"] == "cpu"
         assert os.environ["PALLAS_AXON_POOL_IPS"] == ""
@@ -56,8 +57,9 @@ class TestAcquireBackend:
             bench.subprocess, "run",
             lambda *a, **kw: pytest.fail("probe must not run when forced"))
         monkeypatch.setenv("FEDTPU_BENCH_FORCE_CPU", "1")
-        err = bench._acquire_backend()
+        err, used = bench._acquire_backend()
         assert "FEDTPU_BENCH_FORCE_CPU" in err
+        assert used == 0                     # no probe ever ran
 
 
 class TestArtifact:
@@ -77,13 +79,14 @@ class TestArtifact:
         lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
         assert len(lines) == 1, r.stdout
         art = json.loads(lines[0])
-        for key in ("metric", "value", "unit", "vs_baseline", "error"):
+        for key in ("metric", "value", "unit", "vs_baseline", "error",
+                    "relay_attempts"):
             assert key in art
         assert art["unit"] == "images/sec/chip"
 
     def test_measure_failure_still_emits(self, monkeypatch, capsys):
         """An exception mid-measurement must not kill the artifact."""
-        monkeypatch.setattr(bench, "_acquire_backend", lambda: None)
+        monkeypatch.setattr(bench, "_acquire_backend", lambda: (None, 1))
         monkeypatch.setattr(bench, "_run_measurement",
                             lambda out: (_ for _ in ()).throw(
                                 RuntimeError("chip fell over")))
@@ -107,8 +110,9 @@ class TestSameCommitPromotion:
            "git": "abc1234", "mtime": 1}
 
     def _main(self, monkeypatch, capsys, git, ref=REF, measured=False):
-        monkeypatch.setattr(bench, "_acquire_backend",
-                            lambda: None if measured else "relay wedged")
+        monkeypatch.setattr(
+            bench, "_acquire_backend",
+            lambda: (None, 1) if measured else ("relay wedged", 3))
         def fake_measure(out):
             if measured:
                 out.update(value=9999.0, vs_baseline=2.0, measured=True)
