@@ -196,13 +196,84 @@ def _bench_scale() -> tuple:
     return K, batch, steps, reps
 
 
-def _bench_round(trainer, ci, *, reps, with_comm=False, with_staging=False):
+#: RunRecorder for the current measurement suite (obs/): every timed
+#: region emits one schema-validated round record into
+#: artifacts/bench.jsonl, and the throughput fields the artifact
+#: publishes are DERIVED from those records (report.record_ips), so the
+#: JSONL is the primary perf evidence and the JSON artifact a view of it.
+_BENCH_OBS = None
+
+
+def _open_bench_obs(out: dict):
+    """Open the bench RunRecorder (never let obs break the artifact)."""
+    global _BENCH_OBS
+    try:
+        from federated_pytorch_test_tpu.obs import make_recorder
+
+        art = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "artifacts")
+        obs = make_recorder("jsonl", art, run_name="bench", engine="bench")
+        obs.open(config={k: v for k, v in os.environ.items()
+                         if k.startswith("FEDTPU_BENCH")})
+        if obs.jsonl_path:
+            out["obs_jsonl"] = os.path.join(
+                "artifacts", os.path.basename(obs.jsonl_path))
+        _BENCH_OBS = obs
+    except Exception as e:      # noqa: BLE001 — telemetry is best-effort
+        print(f"bench: obs recorder unavailable: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        _BENCH_OBS = None
+    return _BENCH_OBS
+
+
+def _close_bench_obs(status: str = "completed") -> None:
+    global _BENCH_OBS
+    if _BENCH_OBS is not None:
+        try:
+            _BENCH_OBS.close(status=status)
+        except Exception:       # noqa: BLE001
+            pass
+        _BENCH_OBS = None
+
+
+#: last record built by _obs_emit_round — sections that publish a field
+#: of the record (e.g. compression bytes/round) read it from here so the
+#: artifact value and the telemetry value share one source
+_LAST_OBS_ROUND: dict = {}
+
+
+def _obs_emit_round(**fields) -> dict:
+    """Emit one bench timed-region record; returns the record either way
+    so callers derive their published numbers from it (record_ips)."""
+    obs = _BENCH_OBS
+    rec = dict(fields)
+    _LAST_OBS_ROUND.clear()
+    _LAST_OBS_ROUND.update(rec)
+    if obs is not None and obs.enabled:
+        try:
+            idx = getattr(obs, "_bench_next_index", 0)
+            obs._bench_next_index = idx + 1
+            emitted = obs.round(dict(rec, round_index=idx))
+            if emitted is not None:
+                return emitted
+        except Exception as e:  # noqa: BLE001 — telemetry is best-effort
+            print(f"bench: obs round emit failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+    return rec
+
+
+def _bench_round(trainer, ci, *, reps, with_comm=False, with_staging=False,
+                 label=None):
     """images/sec/chip for block ci's local epoch under ``trainer``'s
     algorithm.  ``with_comm`` adds the comm round (+write-back) per
     rep; ``with_staging`` pays the per-epoch staging inside the timed
     region, exactly as a production round does — an on-device
     permutation gather under the default device-resident data path,
     or host shuffle + uint8 H2D copy on the fallback.
+
+    The timed region lands in the bench obs JSONL as one round record
+    (``label`` names it) and the returned throughput is computed FROM
+    that record, so artifact and telemetry cannot disagree.
 
     Module-level (not a closure of ``_measure``) so the VAE and
     compression sections bench their trainers through the identical
@@ -274,7 +345,17 @@ def _bench_round(trainer, ci, *, reps, with_comm=False, with_staging=False):
         state, z, y, rho, losses, diag = round_(state, z, y, rho)
     sync(losses, diag)
     dt = time.perf_counter() - t0
-    return reps * images_per_epoch / dt / trainer.D
+
+    from federated_pytorch_test_tpu.obs.report import record_ips
+
+    fields = dict(label=label or f"block_{ci}", N=int(N), K=int(K),
+                  round_seconds=dt, images=reps * images_per_epoch,
+                  nadmm=reps)
+    if with_comm and trainer.algo.communicates:
+        fields["bytes_on_wire"] = reps * trainer.round_bytes_on_wire(N, K)
+        fields["bytes_dense"] = reps * 4 * N * K
+    rec = _obs_emit_round(**fields)
+    return record_ips(rec, trainer.D)
 
 
 def _measure(out: dict, progress=lambda: None) -> None:
@@ -298,6 +379,7 @@ def _measure(out: dict, progress=lambda: None) -> None:
 
     n_chips = len(jax.devices())
     K, batch, steps, reps = _bench_scale()
+    _open_bench_obs(out)
 
     cfg = FederatedConfig(K=K, default_batch=batch, check_results=False,
                           use_resnet=True, admm_rho0=0.1, bf16=True)
@@ -322,15 +404,17 @@ def _measure(out: dict, progress=lambda: None) -> None:
     out["staging"] = ("device" if trainer._dev_gather is not None
                       else "host")
 
-    out["stem_block_ips_chip"] = round(bench_block(trainer, 0), 1)
+    out["stem_block_ips_chip"] = round(
+        bench_block(trainer, 0, label="stem_block"), 1)
     progress()
-    out["big_block_ips_chip"] = round(bench_block(trainer, big_ci), 1)
+    out["big_block_ips_chip"] = round(
+        bench_block(trainer, big_ci, label="big_block"), 1)
     progress()
 
     # HEADLINE: the full production consensus round on the biggest block,
     # staging included
     headline = bench_block(trainer, big_ci, with_comm=True,
-                           with_staging=True)
+                           with_staging=True, label="headline_full_round")
     out["value"] = round(headline, 1)
     out["vs_baseline"] = round(headline / TARGET, 3)
     out["measured"] = True
@@ -342,7 +426,7 @@ def _measure(out: dict, progress=lambda: None) -> None:
     # executed FLOPs, hence the MFU basis
     trainer_nc = BlockwiseFederatedTrainer(ResNet18(dtype=jnp.bfloat16),
                                            cfg, data, NoConsensus())
-    full_net = bench_block(trainer_nc, None)
+    full_net = bench_block(trainer_nc, None, label="no_consensus_full_net")
     out["no_consensus_ips_chip"] = round(full_net, 1)
     out["mfu"] = round(full_net * _STEP_FLOPS_PER_IMAGE / _peak_flops(dev), 4)
     progress()
@@ -381,6 +465,7 @@ def _measure(out: dict, progress=lambda: None) -> None:
     except Exception as e:
         print(f"bench_compression failed: {type(e).__name__}: {e}",
               file=sys.stderr)
+    _close_bench_obs()
 
 
 def _bench_cpc() -> dict:
@@ -467,7 +552,8 @@ def _bench_vae() -> dict:
     big_ci = int(np.argmax(sizes))
     out["vae_block_N"] = sizes[big_ci]
     out["vae_ips_chip"] = round(
-        _bench_round(trainer, big_ci, reps=reps, with_comm=True), 1)
+        _bench_round(trainer, big_ci, reps=reps, with_comm=True,
+                     label="vae_big_block"), 1)
 
     # reference clustering-VAE shape: Kc=10 clusters, Lc=32 latent,
     # lambda2=1e-3 (federated_vae_cl.py:12,22-23); encoder block ci=0
@@ -478,7 +564,8 @@ def _bench_vae() -> dict:
                               FedAvg())
     out["vaecl_block_N"] = trainer_cl.block_size(0)
     out["vaecl_ips_chip"] = round(
-        _bench_round(trainer_cl, 0, reps=reps, with_comm=True), 1)
+        _bench_round(trainer_cl, 0, reps=reps, with_comm=True,
+                     label="vaecl_encoder_block"), 1)
     return out
 
 
@@ -517,10 +604,15 @@ def _bench_compression(cfg, data, big_ci) -> dict:
                                             cfg_c, data, AdmmConsensus())
         N = trainer.block_size(big_ci)
         out.setdefault("compress_block_N", N)
-        out[f"compress_{name}_bytes_round"] = trainer.round_bytes_on_wire(
-            N, cfg.K)
-        out[f"compress_{name}_round_ips_chip"] = round(
-            _bench_round(trainer, big_ci, reps=reps, with_comm=True), 1)
+        ips = _bench_round(trainer, big_ci, reps=reps, with_comm=True,
+                           label=f"compress_{name}")
+        out[f"compress_{name}_round_ips_chip"] = round(ips, 1)
+        # published bytes/round come from the emitted obs record (the
+        # timed region covers ``reps`` comm rounds)
+        out[f"compress_{name}_bytes_round"] = (
+            _LAST_OBS_ROUND["bytes_on_wire"] // reps
+            if _LAST_OBS_ROUND.get("bytes_on_wire")
+            else trainer.round_bytes_on_wire(N, cfg.K))
         if name != "none":       # encode+decode overhead in isolation
             comp = make_compressor(kw["compress"],
                                    topk_frac=kw.get("topk_frac", 0.01),
@@ -612,6 +704,7 @@ def _measure_child() -> int:
     except Exception as e:          # noqa: BLE001 — report partial fields
         out["error"] = f"{type(e).__name__}: {e}"
         rc = 1
+    _close_bench_obs(status="completed" if rc == 0 else "aborted")
     print(json.dumps(out), flush=True)
     return rc
 

@@ -31,7 +31,7 @@ def build_parser(defaults: FederatedConfig, prog: str) -> argparse.ArgumentParse
                     "(reference parity: see module docstring)")
     # converters for Optional[...] fields (default None carries no type)
     _optional_types = {"data_dir": str, "num_devices": int,
-                       "profile_dir": str}
+                       "profile_dir": str, "obs_dir": str}
     # tri-state booleans: absent -> None (auto), --flag/--no-flag override
     _optional_bools = {"device_data"}
     for f in dataclasses.fields(FederatedConfig):
@@ -76,6 +76,21 @@ def build_parser(defaults: FederatedConfig, prog: str) -> argparse.ArgumentParse
 def config_from_args(args: argparse.Namespace) -> FederatedConfig:
     kw = {f.name: getattr(args, f.name) for f in dataclasses.fields(FederatedConfig)}
     return FederatedConfig(**kw)
+
+
+def default_obs_dir(cfg: FederatedConfig) -> FederatedConfig:
+    """Driver-entry observability default: file telemetry ON.
+
+    A driver run with no ``--obs-dir`` writes its JSONL under
+    ``<checkpoint_dir>/obs`` (``--obs-sinks none`` opts out); bare
+    engine-API callers (unit tests) keep the file-free ``auto``+None
+    behaviour.  Summarise with
+    ``python -m federated_pytorch_test_tpu.obs.report <file>``.
+    """
+    if cfg.obs_dir is None and cfg.obs_sinks == "auto":
+        cfg = dataclasses.replace(
+            cfg, obs_dir=os.path.join(cfg.checkpoint_dir, "obs"))
+    return cfg
 
 
 def setup_runtime(cfg: FederatedConfig) -> None:
@@ -193,13 +208,23 @@ def maybe_load(trainer: BlockwiseFederatedTrainer, name: str):
     return state
 
 
+def print_obs_artifact(trainer) -> None:
+    """Point the operator at the run's JSONL telemetry (if any)."""
+    rec = getattr(trainer, "obs_recorder", None)
+    if rec is not None and rec.jsonl_path:
+        print(f"obs artifact -> {rec.jsonl_path} "
+              f"(python -m federated_pytorch_test_tpu.obs.report "
+              f"{rec.jsonl_path})")
+
+
 def run_classifier_driver(prog: str, defaults: FederatedConfig,
                           algorithm: Algorithm, independent: bool = False,
                           argv=None):
     args = build_parser(defaults, prog).parse_args(argv)
-    cfg = config_from_args(args)
+    cfg = default_obs_dir(config_from_args(args))
     setup_runtime(cfg)
     trainer = make_trainer(cfg, algorithm, args.n_train, args.n_test)
+    trainer.obs_run_name = prog
     mname = type(trainer.model).__name__
     if mname == "ResNet":
         mname = f"ResNet{trainer.model.qualifier}"
@@ -215,5 +240,6 @@ def run_classifier_driver(prog: str, defaults: FederatedConfig,
         state, history = trainer.run(state, checkpoint_path=ck,
                                      resume=cfg.load_model and ck is not None)
     print("Finished Training")
+    print_obs_artifact(trainer)
     finish(trainer, state, prog, history)
     return state, history

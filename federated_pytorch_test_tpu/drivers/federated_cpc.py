@@ -47,6 +47,12 @@ def main(argv=None):
     p.add_argument("--checkpoint-dir", default="./checkpoints")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler (XProf) trace of the run")
+    p.add_argument("--obs-dir", default=None,
+                   help="directory for observability artifacts (default: "
+                        "<checkpoint-dir>/obs)")
+    p.add_argument("--obs-sinks", default="auto",
+                   help="comma-separated obs sinks "
+                        "(auto|none|jsonl|csv|stdout|memory)")
     p.add_argument("--num-devices", type=int, default=None,
                    help="mesh size (default: as many devices as divide K)")
     p.add_argument("--midrun-checkpoint",
@@ -88,11 +94,20 @@ def main(argv=None):
         print(f"loaded checkpoint <- {ckpt}")
     midrun = (os.path.join(args.checkpoint_dir, "federated_cpc_midrun")
               if args.midrun_checkpoint else None)
+    # same driver-entry default as the classifier drivers
+    # (common.default_obs_dir): file telemetry on unless opted out
+    obs_dir = args.obs_dir
+    if obs_dir is None and args.obs_sinks == "auto":
+        obs_dir = os.path.join(args.checkpoint_dir, "obs")
     state, history = trainer.run(Nloop=args.Nloop, Nadmm=args.Nadmm,
                                  state=state, profile_dir=args.profile_dir,
                                  checkpoint_path=midrun,
-                                 resume=args.load_model and midrun is not None)
+                                 resume=args.load_model and midrun is not None,
+                                 obs_dir=obs_dir, obs_sinks=args.obs_sinks,
+                                 obs_run_name="federated_cpc")
     print("Finished Training")
+    from federated_pytorch_test_tpu.drivers.common import print_obs_artifact
+    print_obs_artifact(trainer)
     if args.save_model:
         save_checkpoint(ckpt, state._asdict(), meta={"rounds": len(history)})
         print(f"saved checkpoint -> {ckpt}")

@@ -17,13 +17,14 @@ DEFAULTS = FederatedConfig(K=10, Nloop=12, Nepoch=1, Nadmm=3,
 
 def main(argv=None):
     args = common.build_parser(DEFAULTS, "federated_vae").parse_args(argv)
-    cfg = common.config_from_args(args)
+    cfg = common.default_obs_dir(common.config_from_args(args))
     common.setup_runtime(cfg)
     data = FederatedCifar10(
         K=cfg.K, batch=cfg.default_batch, biased_input=cfg.biased_input,
         drop_last_sample=cfg.drop_last_sample, data_dir=cfg.data_dir,
         limit_per_client=args.n_train, limit_test=args.n_test)
     trainer = VAETrainer(AutoEncoderCNN(), cfg, data, FedAvg())
+    trainer.obs_run_name = "federated_vae"
     print(f"federated_vae: K={cfg.K} devices={trainer.D} data={data.source}")
     state = common.maybe_load(trainer, "federated_vae")
     ck = (common.checkpoint_path(cfg, "federated_vae_midrun")
@@ -31,6 +32,7 @@ def main(argv=None):
     state, history = trainer.run(state, checkpoint_path=ck,
                                  resume=cfg.load_model and ck is not None)
     print("Finished Training")
+    common.print_obs_artifact(trainer)
     common.finish(trainer, state, "federated_vae", history)
     return state, history
 

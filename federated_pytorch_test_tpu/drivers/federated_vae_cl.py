@@ -25,7 +25,7 @@ def main(argv=None):
     p.add_argument("--Lc", type=int, default=32,
                    help="latent dimension (federated_vae_cl.py:23)")
     args = p.parse_args(argv)
-    cfg = common.config_from_args(args)
+    cfg = common.default_obs_dir(common.config_from_args(args))
     common.setup_runtime(cfg)
     data = FederatedCifar10(
         K=cfg.K, batch=cfg.default_batch, biased_input=cfg.biased_input,
@@ -33,6 +33,7 @@ def main(argv=None):
         limit_per_client=args.n_train, limit_test=args.n_test)
     model = AutoEncoderCNNCL(K=args.Kc, L=args.Lc)
     trainer = VAECLTrainer(model, cfg, data, FedAvg())
+    trainer.obs_run_name = "federated_vae_cl"
     print(f"federated_vae_cl: K={cfg.K} Kc={args.Kc} Lc={args.Lc} "
           f"devices={trainer.D} data={data.source}")
     state = common.maybe_load(trainer, "federated_vae_cl")
@@ -41,6 +42,7 @@ def main(argv=None):
     state, history = trainer.run(state, checkpoint_path=ck,
                                  resume=cfg.load_model and ck is not None)
     print("Finished Training")
+    common.print_obs_artifact(trainer)
     common.finish(trainer, state, "federated_vae_cl", history)
     return state, history
 

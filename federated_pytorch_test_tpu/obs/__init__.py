@@ -1,0 +1,39 @@
+"""Observability: structured run telemetry for every engine.
+
+- :mod:`.metrics`  — host-side counters / gauges / timers.
+- :mod:`.schema`   — versioned run_header / round / summary records.
+- :mod:`.sinks`    — JSONL / CSV / stdout / in-memory emitters.
+- :mod:`.recorder` — the per-run emitter the engines thread through.
+- :mod:`.report`   — ``python -m federated_pytorch_test_tpu.obs.report``.
+
+See README "Observability" for the artifact format and how XProf traces
+(``--profile-dir`` + per-round ``StepTraceAnnotation``) correlate with
+the JSONL timeline.
+"""
+
+from federated_pytorch_test_tpu.obs.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Metrics,
+    Timer,
+)
+from federated_pytorch_test_tpu.obs.recorder import (  # noqa: F401
+    RunRecorder,
+    device_memory_stats,
+    git_rev,
+    make_recorder,
+)
+from federated_pytorch_test_tpu.obs.schema import (  # noqa: F401
+    SCHEMA_VERSION,
+    SchemaError,
+    json_safe,
+    validate_record,
+)
+from federated_pytorch_test_tpu.obs.sinks import (  # noqa: F401
+    CsvSink,
+    JsonlSink,
+    MemorySink,
+    Sink,
+    StdoutSink,
+    make_sinks,
+)

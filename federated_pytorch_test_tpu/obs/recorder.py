@@ -1,0 +1,256 @@
+"""RunRecorder: the per-run event emitter the engines thread through.
+
+Lifecycle::
+
+    rec = make_recorder(obs_sinks=cfg.obs_sinks, obs_dir=cfg.obs_dir,
+                        run_name="federated_multi", engine="classifier",
+                        algorithm="fedavg")
+    rec.open(config=dataclasses.asdict(cfg), mesh_shape=dict(mesh.shape),
+             resumed=False, rounds_prior=0)
+    for ...:
+        rec.round({...per-round fields...})       # one per comm round
+    rec.close(status="completed")                 # or "aborted"
+
+Everything happens on the HOST at round boundaries — no host callbacks
+inside jitted code, no extra device syncs — so with sinks disabled
+(``obs_sinks="none"``) the recorder short-circuits to no-ops and the
+numerical path is bit-identical by construction.
+
+``round()`` enforces strictly increasing ``round_index`` (the engines
+use the global history length, which the mid-run checkpoint restores),
+so a resumed run APPENDS monotonically to the same JSONL — never
+duplicates.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Sequence
+
+from federated_pytorch_test_tpu.obs.metrics import Metrics
+from federated_pytorch_test_tpu.obs.schema import (
+    SCHEMA_VERSION,
+    SchemaError,
+    json_safe,
+    validate_record,
+)
+from federated_pytorch_test_tpu.obs.sinks import MemorySink, Sink, make_sinks
+
+#: round fields summed into *_total summary fields
+_SUMMED = ("bytes_on_wire", "bytes_dense", "images", "guard_trips",
+           "fault_dropped", "fault_straggled", "fault_corrupted")
+_SUMMED_SECONDS = ("round_seconds", "stage_seconds", "comm_seconds")
+
+
+def device_memory_stats() -> Dict[str, int]:
+    """Summed ``memory_stats()`` over ``jax.local_devices()``.
+
+    ``{}`` when the backend reports nothing (CPU) — the round record
+    simply omits the fields, per the schema's "where available".
+    """
+    try:
+        import jax
+
+        per = [d.memory_stats() for d in jax.local_devices()]
+    except Exception:
+        return {}
+    per = [s for s in per if s]
+    if not per:
+        return {}
+    out: Dict[str, int] = {}
+    in_use = [s.get("bytes_in_use") for s in per]
+    peak = [s.get("peak_bytes_in_use") for s in per]
+    if all(v is not None for v in in_use):
+        out["mem_bytes_in_use"] = int(sum(in_use))
+    if all(v is not None for v in peak):
+        out["mem_peak_bytes_in_use"] = int(sum(peak))
+    return out
+
+
+def git_rev() -> Optional[str]:
+    """Short git rev of the source tree, or None outside a checkout."""
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        p = subprocess.run(["git", "-C", root, "rev-parse", "--short",
+                            "HEAD"], capture_output=True, text=True,
+                           timeout=5)
+    except Exception:
+        return None
+    rev = p.stdout.strip()
+    return rev if p.returncode == 0 and rev else None
+
+
+class RunRecorder:
+    """Validates records against the schema and fans them out to sinks."""
+
+    def __init__(self, sinks: Sequence[Sink], *, engine: str,
+                 algorithm: Optional[str] = None, run_name: str = "run",
+                 run_id: Optional[str] = None,
+                 jsonl_path: Optional[str] = None):
+        self.sinks = list(sinks)
+        self.engine = engine
+        self.algorithm = algorithm
+        self.run_name = run_name
+        self.run_id = run_id or uuid.uuid4().hex[:8]
+        self.jsonl_path = jsonl_path
+        self.enabled = bool(self.sinks)
+        self.totals = Metrics()
+        self._opened = False
+        self._closed = False
+        self._t0 = None
+        self._last_index: Optional[int] = None
+        self._loss_first: Optional[float] = None
+        self._loss_final: Optional[float] = None
+
+    @property
+    def memory(self) -> Optional[List[dict]]:
+        """Records captured by the first MemorySink, if one is attached."""
+        for s in self.sinks:
+            if isinstance(s, MemorySink):
+                return s.records
+        return None
+
+    def _emit(self, rec: Dict[str, Any]) -> Dict[str, Any]:
+        validate_record(rec)
+        for s in self.sinks:
+            s.emit(rec)
+        return rec
+
+    def open(self, *, config: Optional[dict] = None,
+             mesh_shape: Optional[dict] = None, resumed: bool = False,
+             rounds_prior: int = 0,
+             extra: Optional[dict] = None) -> Optional[dict]:
+        """Emit the run-header event; returns it (None when disabled)."""
+        self._opened = True
+        self._t0 = time.monotonic()
+        self._last_index = rounds_prior - 1 if rounds_prior else None
+        if not self.enabled:
+            return None
+        import jax
+        import jaxlib
+
+        rec: Dict[str, Any] = {
+            "event": "run_header", "schema": SCHEMA_VERSION,
+            "run_id": self.run_id, "run_name": self.run_name,
+            "engine": self.engine, "time_unix": time.time(),
+            "devices": jax.device_count(),
+            "local_devices": jax.local_device_count(),
+            "platform": jax.default_backend(),
+            "jax_version": jax.__version__,
+            "jaxlib_version": jaxlib.__version__,
+            "resumed": bool(resumed), "rounds_prior": int(rounds_prior),
+            "host": socket.gethostname(), "pid": os.getpid(),
+        }
+        if self.algorithm is not None:
+            rec["algorithm"] = self.algorithm
+        rev = git_rev()
+        if rev is not None:
+            rec["git_rev"] = rev
+        if config is not None:
+            rec["config"] = json_safe(config)
+        if mesh_shape is not None:
+            rec["mesh_shape"] = json_safe(mesh_shape)
+        if extra:
+            rec.update(json_safe(extra))
+        return self._emit(rec)
+
+    def round(self, fields: Dict[str, Any]) -> Optional[dict]:
+        """Emit one round record; enforces monotone ``round_index``."""
+        if not self.enabled:
+            return None
+        idx = fields.get("round_index")
+        if not isinstance(idx, int):
+            raise SchemaError(f"round() needs an int round_index, "
+                              f"got {idx!r}")
+        if self._last_index is not None and idx <= self._last_index:
+            raise SchemaError(
+                f"round_index went backwards: {idx} after "
+                f"{self._last_index} (duplicate or out-of-order round)")
+        self._last_index = idx
+        rec = {"event": "round", "schema": SCHEMA_VERSION,
+               "run_id": self.run_id, "engine": self.engine}
+        if self.algorithm is not None:
+            rec["algorithm"] = self.algorithm
+        rec.update(json_safe(fields))
+        self.totals.counter("rounds").inc()
+        for k in _SUMMED:
+            v = rec.get(k)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                self.totals.counter(k + "_total").inc(v)
+        for k in _SUMMED_SECONDS:
+            v = rec.get(k)
+            if isinstance(v, (int, float)):
+                self.totals.timer(k[: -len("_seconds")]).observe(v)
+        if isinstance(rec.get("quarantined"), int):
+            self.totals.gauge("quarantined_last").set(rec["quarantined"])
+        loss = rec.get("loss")
+        if isinstance(loss, (int, float)):
+            if self._loss_first is None:
+                self._loss_first = float(loss)
+            self._loss_final = float(loss)
+        return self._emit(rec)
+
+    def close(self, status: str = "completed",
+              extra: Optional[dict] = None) -> Optional[dict]:
+        """Emit the summary event and close every sink. Idempotent."""
+        if self._closed:
+            return None
+        self._closed = True
+        if not self.enabled:
+            return None
+        snap = self.totals.snapshot()
+        rounds = int(snap.get("rounds", 0))
+        rec: Dict[str, Any] = {
+            "event": "summary", "schema": SCHEMA_VERSION,
+            "run_id": self.run_id, "status": status, "rounds": rounds,
+            "time_unix": time.time(),
+        }
+        if self._t0 is not None:
+            rec["total_seconds"] = time.monotonic() - self._t0
+        for k in _SUMMED:
+            if k + "_total" in snap:
+                v = snap[k + "_total"]
+                rec[k + "_total"] = (int(v) if float(v).is_integer()
+                                     else float(v))
+        for k in _SUMMED_SECONDS:
+            base = k[: -len("_seconds")]
+            if base + "_seconds" in snap:
+                rec[k + "_total"] = snap[base + "_seconds"]
+        if "quarantined_last" in snap:
+            rec["quarantined_last"] = snap["quarantined_last"]
+        if self._loss_first is not None:
+            rec["loss_first"] = self._loss_first
+            rec["loss_final"] = self._loss_final
+        rs = rec.get("round_seconds_total", 0.0)
+        if rounds and rs:
+            rec["rounds_per_sec"] = rounds / rs
+            if rec.get("images_total"):
+                rec["images_per_sec"] = rec["images_total"] / rs
+            if "comm_seconds_total" in rec:
+                rec["comm_overhead_frac"] = rec["comm_seconds_total"] / rs
+        if rec.get("bytes_dense_total"):
+            rec["compression_savings_frac"] = (
+                1.0 - rec.get("bytes_on_wire_total", 0)
+                / rec["bytes_dense_total"])
+        if extra:
+            rec.update(json_safe(extra))
+        out = self._emit(rec)
+        for s in self.sinks:
+            s.close()
+        return out
+
+
+def make_recorder(obs_sinks: str = "auto", obs_dir: Optional[str] = None,
+                  *, run_name: str = "run", engine: str = "run",
+                  algorithm: Optional[str] = None,
+                  extra_sinks: Sequence[Sink] = ()) -> RunRecorder:
+    """Build a RunRecorder from the ``--obs-sinks``/``--obs-dir`` knobs."""
+    sinks, jsonl_path = make_sinks(obs_sinks, obs_dir, run_name)
+    sinks.extend(extra_sinks)
+    return RunRecorder(sinks, engine=engine, algorithm=algorithm,
+                       run_name=run_name, jsonl_path=jsonl_path)

@@ -1,0 +1,236 @@
+"""Run-summary CLI over the obs JSONL artifact.
+
+``python -m federated_pytorch_test_tpu.obs.report run.jsonl`` parses,
+schema-validates, and summarises one run file (throughput, comm
+overhead %, bytes saved by compression, fault/guard tallies) — the same
+numbers bench.py embeds in its artifact, derived from the same records.
+
+``--selftest`` synthesises a tiny run through the real
+recorder→JSONL→parse→validate→summarise pipeline and asserts the
+round-trip, so the tier-1 flow can keep this CLI from rotting without
+needing a prior training run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+from federated_pytorch_test_tpu.obs.schema import (
+    SchemaError,
+    validate_record,
+)
+
+
+def read_records(path: str, validate: bool = True) -> List[Dict[str, Any]]:
+    """Parse a JSONL run file; optionally schema-validate every record."""
+    records = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise SchemaError(f"{path}:{lineno}: not JSON ({e})")
+            if validate:
+                try:
+                    validate_record(rec)
+                except SchemaError as e:
+                    raise SchemaError(f"{path}:{lineno}: {e}")
+            records.append(rec)
+    return records
+
+
+def record_ips(rec: Dict[str, Any], n_chips: int = 1) -> float:
+    """images/sec(/chip) of one round record (bench throughput unit)."""
+    return rec["images"] / rec["round_seconds"] / max(n_chips, 1)
+
+
+def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Aggregate a record stream into one stats dict.
+
+    Totals are recomputed from the ``round`` records (the embedded
+    ``summary`` events are reported but not trusted), so a truncated
+    file — killed run, no summary — still summarises.  Handles multiple
+    header/summary segments (a resumed run appends a new segment to the
+    same file).
+    """
+    headers = [r for r in records if r.get("event") == "run_header"]
+    rounds = [r for r in records if r.get("event") == "round"]
+    summaries = [r for r in records if r.get("event") == "summary"]
+    idx = [r["round_index"] for r in rounds]
+    monotonic = all(b > a for a, b in zip(idx, idx[1:]))
+
+    def tot(key):
+        vals = [r[key] for r in rounds if isinstance(r.get(key), (int, float))]
+        return sum(vals) if vals else None
+
+    out: Dict[str, Any] = {
+        "path_schema": max((r.get("schema", 0) for r in records), default=0),
+        "headers": len(headers),
+        "summaries": len(summaries),
+        "rounds": len(rounds),
+        "round_index_first": idx[0] if idx else None,
+        "round_index_last": idx[-1] if idx else None,
+        "monotonic": monotonic,
+        "engine": headers[-1].get("engine") if headers else
+                  (rounds[-1].get("engine") if rounds else None),
+        "algorithm": headers[-1].get("algorithm") if headers else None,
+        "run_id": headers[-1].get("run_id") if headers else None,
+        "status": summaries[-1].get("status") if summaries else "truncated",
+    }
+    for key in ("round_seconds", "stage_seconds", "comm_seconds",
+                "bytes_on_wire", "bytes_dense", "images", "guard_trips",
+                "fault_dropped", "fault_straggled", "fault_corrupted"):
+        out[key + "_total"] = tot(key)
+    losses = [r["loss"] for r in rounds
+              if isinstance(r.get("loss"), (int, float))]
+    out["loss_first"] = losses[0] if losses else None
+    out["loss_final"] = losses[-1] if losses else None
+    q = [r["quarantined"] for r in rounds
+         if isinstance(r.get("quarantined"), int)]
+    out["quarantined_last"] = q[-1] if q else None
+    rs = out["round_seconds_total"]
+    if rounds and rs:
+        out["rounds_per_sec"] = len(rounds) / rs
+        if out["images_total"]:
+            out["images_per_sec"] = out["images_total"] / rs
+        if out["comm_seconds_total"] is not None:
+            out["comm_overhead_frac"] = out["comm_seconds_total"] / rs
+    if out["bytes_dense_total"]:
+        out["compression_savings_frac"] = (
+            1.0 - (out["bytes_on_wire_total"] or 0)
+            / out["bytes_dense_total"])
+    return out
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n:.0f} B"
+        n /= 1024
+    return f"{n:.1f} GiB"
+
+
+def format_report(s: Dict[str, Any]) -> str:
+    """Human-readable summary table (stable two-column layout)."""
+    lines = [
+        f"run {s.get('run_id') or '?'} · engine={s.get('engine') or '?'}"
+        f" · algo={s.get('algorithm') or '?'}"
+        f" · schema v{s.get('path_schema')} · status={s.get('status')}",
+    ]
+
+    def row(label, value):
+        lines.append(f"  {label:<22}{value}")
+
+    mono = "monotonic" if s.get("monotonic") else "NON-MONOTONIC"
+    row("rounds", f"{s['rounds']}  (indices {s.get('round_index_first')}"
+        f"..{s.get('round_index_last')}, {mono}; "
+        f"{s['headers']} header(s), {s['summaries']} summary(ies))")
+    rs = s.get("round_seconds_total")
+    if rs:
+        per = rs / max(s["rounds"], 1)
+        row("wall clock", f"{rs:.2f} s  ({per:.3f} s/round, "
+            f"{s.get('rounds_per_sec', 0.0):.2f} rounds/s)")
+    if s.get("images_total"):
+        row("throughput", f"{s.get('images_per_sec', 0.0):,.0f} images/s"
+            f"  ({s['images_total']:,} images)")
+    if s.get("comm_seconds_total") is not None and rs:
+        row("comm overhead", f"{100.0 * s.get('comm_overhead_frac', 0.0):.1f} %"
+            f"  ({s['comm_seconds_total']:.2f} s in comm+sync)")
+    if s.get("bytes_on_wire_total") is not None:
+        msg = _fmt_bytes(s["bytes_on_wire_total"])
+        if s.get("bytes_dense_total"):
+            msg += (f"  (dense {_fmt_bytes(s['bytes_dense_total'])}, "
+                    f"saved {100.0 * s.get('compression_savings_frac', 0.0):.1f}%)")
+        row("bytes on wire", msg)
+    faults = {k: s.get(k + "_total") for k in
+              ("guard_trips", "fault_dropped", "fault_straggled",
+               "fault_corrupted")}
+    if any(v for v in faults.values()) or s.get("quarantined_last"):
+        row("guards/faults",
+            f"trips={faults['guard_trips'] or 0:g} "
+            f"drop={faults['fault_dropped'] or 0} "
+            f"straggle={faults['fault_straggled'] or 0} "
+            f"corrupt={faults['fault_corrupted'] or 0} "
+            f"quarantined_last={s.get('quarantined_last') or 0}")
+    if s.get("loss_first") is not None:
+        row("loss", f"first={s['loss_first']:.6g} "
+            f"final={s['loss_final']:.6g}")
+    return "\n".join(lines)
+
+
+def selftest() -> str:
+    """Recorder → JSONL → parse → validate → summarise round-trip."""
+    import os
+    import tempfile
+
+    from federated_pytorch_test_tpu.obs.recorder import make_recorder
+
+    with tempfile.TemporaryDirectory() as d:
+        rec = make_recorder("jsonl", d, run_name="selftest",
+                            engine="selftest", algorithm="fedavg")
+        rec.open(config={"K": 2, "Nadmm": 3}, mesh_shape={"clients": 1})
+        for i in range(3):
+            rec.round({"round_index": i, "nloop": 0, "block": 0,
+                       "nadmm": i, "N": 100, "loss": 2.0 - 0.5 * i,
+                       "rho": 1.0, "round_seconds": 0.5,
+                       "stage_seconds": 0.01, "comm_seconds": 0.1,
+                       "bytes_on_wire": 100, "bytes_dense": 400,
+                       "images": 256, "guard_trips": 1 if i == 2 else 0,
+                       "quarantined": 0})
+        rec.close()
+        path = os.path.join(d, "selftest.jsonl")
+        records = read_records(path)
+        assert len(records) == 5, f"expected 5 records, got {len(records)}"
+        s = summarize(records)
+        assert s["rounds"] == 3 and s["monotonic"], s
+        assert s["bytes_on_wire_total"] == 300, s
+        assert s["bytes_dense_total"] == 1200, s
+        assert abs(s["compression_savings_frac"] - 0.75) < 1e-9, s
+        assert s["guard_trips_total"] == 1, s
+        assert s["loss_final"] == 1.0, s
+        assert s["status"] == "completed", s
+        table = format_report(s)
+    return table + "\nobs report selftest: OK"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m federated_pytorch_test_tpu.obs.report",
+        description="Summarise an obs run JSONL (see README "
+                    "'Observability')")
+    p.add_argument("path", nargs="?", help="run JSONL file")
+    p.add_argument("--json", action="store_true",
+                   help="print the summary as one JSON object")
+    p.add_argument("--no-validate", action="store_true",
+                   help="skip schema validation while parsing")
+    p.add_argument("--selftest", action="store_true",
+                   help="run the built-in round-trip selftest and exit")
+    args = p.parse_args(argv)
+    if args.selftest:
+        print(selftest())
+        return 0
+    if not args.path:
+        p.error("a run JSONL path is required (or --selftest)")
+    try:
+        records = read_records(args.path, validate=not args.no_validate)
+    except (OSError, SchemaError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if not records:
+        print(f"error: {args.path} holds no records", file=sys.stderr)
+        return 1
+    s = summarize(records)
+    print(json.dumps(s) if args.json else format_report(s))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

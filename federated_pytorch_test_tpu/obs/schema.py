@@ -1,0 +1,214 @@
+"""Versioned record schema for run telemetry.
+
+One run = one JSONL stream of three event kinds:
+
+- ``run_header``  — emitted once when a run (or resumed segment) opens:
+  config snapshot, mesh shape, jax/backend versions, git rev.
+- ``round``       — one per communication round (or per epoch on the
+  no-consensus path): loop coordinates, loss/residuals/rho, wall-clock
+  phase timings, ``bytes_on_wire``, guard/fault/quarantine counters,
+  device memory stats where the backend reports them.
+- ``summary``     — emitted once when the run closes (``completed`` or
+  ``aborted``): totals and derived rates.
+
+The schema unifies what ``engine.py``, ``cpc_engine.py`` and
+``vae_engine.py`` used to build as ad-hoc dicts; every record carries
+``schema`` (the version) and validates via :func:`validate_record`.
+Unknown fields are ALLOWED (forward compatibility — a newer writer must
+not break an older reader); known fields are type-checked.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+SCHEMA_VERSION = 1
+
+EVENTS = ("run_header", "round", "summary")
+
+
+class SchemaError(ValueError):
+    """A record fails schema validation (missing/ill-typed field)."""
+
+
+# bool is an int subclass: _INT/_NUM must not silently admit True/False
+_NUM = (int, float)      # numeric (counters may arrive as float from psum)
+_INT = (int,)
+_STR = (str,)
+_BOOL = (bool,)
+_LIST = (list,)
+_DICT = (dict,)
+_ANY = None              # any JSON value
+
+#: known fields -> (event kinds they may appear on, allowed types)
+FIELDS: Dict[str, Any] = {
+    # envelope
+    "event":        (EVENTS, _STR),
+    "schema":       (EVENTS, _INT),
+    "run_id":       (EVENTS, _STR),
+    "run_name":     (("run_header",), _STR),
+    "engine":       (("run_header", "round"), _STR),
+    "algorithm":    (("run_header", "round"), _STR),
+    # header
+    "time_unix":    (("run_header", "summary"), _NUM),
+    "config":       (("run_header",), _DICT),
+    "mesh_shape":   (("run_header",), _DICT),
+    "devices":      (("run_header",), _INT),
+    "local_devices": (("run_header",), _INT),
+    "platform":     (("run_header",), _STR),
+    "jax_version":  (("run_header",), _STR),
+    "jaxlib_version": (("run_header",), _STR),
+    "git_rev":      (("run_header",), _STR),
+    "resumed":      (("run_header",), _BOOL),
+    "rounds_prior": (("run_header",), _INT),
+    "host":         (("run_header",), _STR),
+    "pid":          (("run_header",), _INT),
+    # round coordinates
+    "round_index":  (("round",), _INT),
+    "nloop":        (("round",), _INT),
+    "block":        (("round",), _INT),
+    "nadmm":        (("round",), _INT),
+    "epoch":        (("round",), _INT),
+    "model":        (("round",), _STR),   # CPC submodel name
+    "N":            (("round",), _INT),
+    "label":        (("round",), _STR),   # bench section tag
+    # round measurements
+    "loss":         (("round",), _NUM),
+    "rho":          (("round",), _NUM),
+    "dual_residual": (("round",), _NUM),
+    "primal_residual": (("round",), _NUM),
+    "accuracy":     (("round",), _LIST),
+    "images":       (("round",), _INT),
+    # wall-clock phase segments (time.monotonic/perf_counter on host;
+    # they sum to ~round_seconds — see README "Observability" for the
+    # single-host-sync attribution caveat)
+    "round_seconds": (("round",), _NUM),
+    "stage_seconds": (("round",), _NUM),
+    "train_seconds": (("round",), _NUM),
+    "comm_seconds": (("round",), _NUM),
+    "sync_seconds": (("round",), _NUM),
+    "compute_seconds": (("round",), _NUM),
+    "epoch_seconds": (("round",), _NUM),
+    # communication volume
+    "bytes_on_wire": (("round",), _INT),
+    "bytes_dense":  (("round",), _INT),
+    # fault / guard counters
+    "guard_trips":  (("round",), _NUM),
+    "guard_norm_mean": (("round",), _NUM),
+    "n_ok":         (("round",), _NUM),
+    "n_active":     (("round",), _NUM),
+    "n_comm":       (("round",), _INT),
+    "quarantined":  (("round",), _INT),
+    "fault_dropped": (("round",), _INT),
+    "fault_straggled": (("round",), _INT),
+    "fault_corrupted": (("round",), _INT),
+    # device memory (absent when the backend reports none, e.g. CPU)
+    "mem_bytes_in_use": (("round",), _INT),
+    "mem_peak_bytes_in_use": (("round",), _INT),
+    # summary totals / rates
+    "status":       (("summary",), _STR),
+    "rounds":       (("summary",), _INT),
+    "total_seconds": (("summary",), _NUM),
+    "round_seconds_total": (("summary",), _NUM),
+    "stage_seconds_total": (("summary",), _NUM),
+    "comm_seconds_total": (("summary",), _NUM),
+    "bytes_on_wire_total": (("summary",), _INT),
+    "bytes_dense_total": (("summary",), _INT),
+    "images_total": (("summary",), _INT),
+    "guard_trips_total": (("summary",), _NUM),
+    "fault_dropped_total": (("summary",), _INT),
+    "fault_straggled_total": (("summary",), _INT),
+    "fault_corrupted_total": (("summary",), _INT),
+    "quarantined_last": (("summary",), _INT),
+    "loss_first":   (("summary",), _NUM),
+    "loss_final":   (("summary",), _NUM),
+    "rounds_per_sec": (("summary",), _NUM),
+    "images_per_sec": (("summary",), _NUM),
+    "comm_overhead_frac": (("summary",), _NUM),
+    "compression_savings_frac": (("summary",), _NUM),
+}
+
+REQUIRED = {
+    "run_header": ("event", "schema", "run_id", "engine", "time_unix"),
+    "round": ("event", "schema", "run_id", "round_index", "engine",
+              "round_seconds"),
+    "summary": ("event", "schema", "run_id", "status", "rounds"),
+}
+
+
+def json_safe(obj):
+    """Coerce ``obj`` into JSON-serialisable types.
+
+    numpy arrays/scalars become lists/Python scalars, tuples become
+    lists, dataclasses become dicts, anything else falls back to
+    ``repr`` — so a config snapshot or an ``accuracy`` ndarray can ride
+    in a record without the caller caring.
+    """
+    import numpy as np
+
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, np.generic):
+        return obj.item()
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, dict):
+        return {str(k): json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set)):
+        return [json_safe(v) for v in obj]
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return json_safe(dataclasses.asdict(obj))
+    return repr(obj)
+
+
+def _type_ok(value, types) -> bool:
+    if types is _ANY or types is None:
+        return True
+    if isinstance(value, bool) and bool not in types:
+        return False            # bool passes isinstance(int) checks
+    if isinstance(value, types):
+        return True
+    # json round-trips ints inside float fields and vice versa
+    if float in types and isinstance(value, int):
+        return True
+    return False
+
+
+def validate_record(rec: Dict[str, Any]) -> Dict[str, Any]:
+    """Validate one record against the schema; returns it unchanged.
+
+    Raises :class:`SchemaError` on: non-dict input, unknown/missing
+    ``event``, missing ``schema`` version or one newer than this reader,
+    a missing required field, or a known field of the wrong type.
+    Unknown fields pass (forward compatibility).
+    """
+    if not isinstance(rec, dict):
+        raise SchemaError(f"record must be a dict, got {type(rec).__name__}")
+    event = rec.get("event")
+    if event not in EVENTS:
+        raise SchemaError(f"unknown event {event!r}; expected one of {EVENTS}")
+    ver = rec.get("schema")
+    if not isinstance(ver, int) or isinstance(ver, bool) or ver < 1:
+        raise SchemaError(f"bad schema version {ver!r}")
+    if ver > SCHEMA_VERSION:
+        raise SchemaError(
+            f"record schema v{ver} is newer than this reader "
+            f"(v{SCHEMA_VERSION})")
+    for name in REQUIRED[event]:
+        if rec.get(name) is None:
+            raise SchemaError(f"{event} record missing required {name!r}")
+    for name, value in rec.items():
+        spec = FIELDS.get(name)
+        if spec is None or value is None:
+            continue                       # unknown field / JSON null: pass
+        kinds, types = spec
+        if event not in kinds:
+            raise SchemaError(
+                f"field {name!r} is not valid on a {event!r} record")
+        if not _type_ok(value, types):
+            raise SchemaError(
+                f"field {name!r} on {event!r} has type "
+                f"{type(value).__name__}, expected one of "
+                f"{tuple(t.__name__ for t in types)}")
+    return rec

@@ -150,5 +150,19 @@ class FederatedConfig:
 
     # tracing/profiling (SURVEY.md section 5): when set, the run is wrapped
     # in jax.profiler.trace(profile_dir) producing a TensorBoard/XProf
-    # trace; per-round wall-clock always lands in history["round_seconds"]
+    # trace with one StepTraceAnnotation("comm_round") per round, keyed on
+    # the obs round_index so the trace lines up with the JSONL timeline;
+    # per-round wall-clock always lands in history["round_seconds"]
     profile_dir: Optional[str] = None
+
+    # observability (obs/): every run emits schema-versioned telemetry —
+    # a run-header event, one validated record per comm round, and a
+    # closing summary — through the sinks named here ("auto" resolves to
+    # jsonl when obs_dir is set, else none; comma-separable choices:
+    # none|jsonl|csv|stdout|memory).  Drivers default obs_dir to
+    # <checkpoint_dir>/obs so real runs are observable out of the box;
+    # "--obs-sinks none" disables file output (emission is host-side at
+    # round boundaries either way, so the math is bit-identical).
+    # Inspect with: python -m federated_pytorch_test_tpu.obs.report
+    obs_dir: Optional[str] = None
+    obs_sinks: str = "auto"

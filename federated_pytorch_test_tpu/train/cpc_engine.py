@@ -46,7 +46,8 @@ from federated_pytorch_test_tpu.parallel.mesh import (
 from federated_pytorch_test_tpu.ops.infonce import info_nce_fused
 from federated_pytorch_test_tpu.utils import blocks as blocklib
 from federated_pytorch_test_tpu.utils import codec
-from federated_pytorch_test_tpu.utils.profiling import profile_ctx
+from federated_pytorch_test_tpu.obs import device_memory_stats, make_recorder
+from federated_pytorch_test_tpu.utils.profiling import profile_ctx, round_trace
 from federated_pytorch_test_tpu.utils.initializers import init_weights
 
 SUBMODELS = ("encoder", "contextgen", "predictor")
@@ -70,6 +71,8 @@ class CPCTrainer:
         self.data = data
         self.K = data.K
         self.Niter = Niter
+        # observability (obs/): last RunRecorder opened by run()
+        self.obs_recorder = None
         self.models = {
             "encoder": EncoderCNN(latent_dim=latent_dim),
             "contextgen": ContextgenCNN(latent_dim=latent_dim),
@@ -310,7 +313,9 @@ class CPCTrainer:
             state: Optional[CPCState] = None,
             log: Callable[[str], None] = print, prefetch: bool = True,
             profile_dir: Optional[str] = None,
-            checkpoint_path: Optional[str] = None, resume: bool = False):
+            checkpoint_path: Optional[str] = None, resume: bool = False,
+            obs_dir: Optional[str] = None, obs_sinks: str = "auto",
+            obs_run_name: str = "cpc_admm"):
         """The rotation loop (federated_cpc.py:194-304).
 
         ``profile_dir`` wraps the run in ``jax.profiler.trace``
@@ -336,13 +341,23 @@ class CPCTrainer:
         pipeline is the bottleneck — visible starvation) and
         ``compute_seconds`` (jitted round, device-synced), plus their sum
         ``round_seconds`` (SURVEY.md section 5 tracing).
+
+        ``obs_dir``/``obs_sinks``/``obs_run_name`` configure the obs/
+        telemetry stream (run header + one schema-validated record per
+        comm round + summary; same contract as the classifier engine —
+        "auto" with no ``obs_dir`` is a no-op, so bare API calls stay
+        file-free).  The last recorder is kept on ``self.obs_recorder``.
         """
         with profile_ctx(profile_dir):
             return self._run_impl(Nloop, Nadmm, state, log, prefetch,
-                                  checkpoint_path, resume)
+                                  checkpoint_path, resume,
+                                  profile_on=profile_dir is not None,
+                                  obs_dir=obs_dir, obs_sinks=obs_sinks,
+                                  obs_run_name=obs_run_name)
 
     def _run_impl(self, Nloop, Nadmm, state, log, prefetch,
-                  checkpoint_path=None, resume=False):
+                  checkpoint_path=None, resume=False, profile_on=False,
+                  obs_dir=None, obs_sinks="auto", obs_run_name="cpc_admm"):
         from federated_pytorch_test_tpu.utils.checkpoint import (
             CheckpointCorruptError,
             checkpoint_slots,
@@ -355,6 +370,7 @@ class CPCTrainer:
         rows = local_client_rows(self.mesh, self.K)
 
         resume_at = r_z = r_opt = None
+        restored = False
         slots = (checkpoint_slots(checkpoint_path)
                  if resume and checkpoint_path is not None else [])
         failures = []
@@ -370,6 +386,7 @@ class CPCTrainer:
                 continue
             log(f"resumed mid-run checkpoint {slot} at "
                 f"(nloop, model, block, nadmm)={resume_at[:4]}")
+            restored = True
             break
         else:
             if failures:
@@ -393,9 +410,19 @@ class CPCTrainer:
                     n_rounds += max(0, Nadmm - start)
         src = (RoundPrefetcher(self.data, self.Niter, n_rounds, clients=rows)
                if prefetch and n_rounds > 0 else None)
-        if slot is not None and n_rounds == 0:
+        # `restored`, not the loop variable: with no slots to walk the
+        # latter is unbound and the check itself would NameError
+        if restored and n_rounds == 0:
             log("resumed a COMPLETED run: no rounds remain at "
                 f"Nloop={Nloop} Nadmm={Nadmm}; returning the saved history")
+        obs = make_recorder(obs_sinks, obs_dir, run_name=obs_run_name,
+                            engine="cpc", algorithm="fedavg")
+        obs.open(config={"Nloop": Nloop, "Nadmm": Nadmm,
+                         "Niter": self.Niter, "K": self.K,
+                         "prefetch": bool(prefetch)},
+                 mesh_shape=dict(self.mesh.shape), resumed=restored,
+                 rounds_prior=len(history))
+        self.obs_recorder = obs
         try:
             for nloop in range(Nloop):
                 for mdl_i, mdl in enumerate(SUBMODELS):
@@ -412,50 +439,68 @@ class CPCTrainer:
                             nadmm_start = resume_at[3]
                         resume_at = None
                         for nadmm in range(nadmm_start, Nadmm):
-                            t_round = time.perf_counter()
-                            px, py, batch = (
-                                src.get() if src is not None
-                                else self.data.round_batches(self.Niter,
-                                                             clients=rows))
-                            fn, init_fn, N = self._build_round(mdl, ci, px,
-                                                               py)
-                            if z is None:
-                                z = stage_global(
-                                    np.zeros((N,), np.float32),
-                                    replicated_sharding(self.mesh))
-                                opt_state = init_fn(state)
-                            staged = stage_client_rows(batch, csh)
-                            t_staged = time.perf_counter()
-                            state, z, opt_state, dual, losses = fn(
-                                state, z, opt_state, staged)
-                            rec = dict(nloop=nloop, model=mdl, block=ci,
-                                       nadmm=nadmm, N=N,
-                                       dual_residual=float(dual),
-                                       loss=float(np.sum(fetch(losses))))
-                            # the float()/fetch above force a device sync,
-                            # so the stage/compute split is honest
-                            t_done = time.perf_counter()
-                            rec["stage_seconds"] = t_staged - t_round
-                            rec["compute_seconds"] = t_done - t_staged
-                            rec["round_seconds"] = t_done - t_round
-                            history.append(rec)
-                            if checkpoint_path is not None:
-                                if nadmm + 1 < Nadmm:
-                                    nxt = (nloop, mdl_i, ci, nadmm + 1)
-                                elif ci + 1 < len(blocks):
-                                    nxt = (nloop, mdl_i, ci + 1, 0)
-                                elif mdl_i + 1 < len(SUBMODELS):
-                                    nxt = (nloop, mdl_i + 1, 0, 0)
-                                else:
-                                    nxt = (nloop + 1, 0, 0, 0)
-                                self._save_midrun(checkpoint_path, state, z,
-                                                  opt_state, px, py, nxt,
-                                                  history)
-                            log(f"dual (N={N},loop={nloop},model={mdl},"
-                                f"block={ci},avg={nadmm})="
-                                f"{rec['dual_residual']:e} "
-                                f"loss={rec['loss']:e}")
+                            # one XProf step per round, keyed on the global
+                            # round index == the obs round_index (classifier-
+                            # engine parity: utils/profiling.round_trace)
+                            with round_trace(len(history), enabled=profile_on):
+                                t_round = time.perf_counter()
+                                px, py, batch = (
+                                    src.get() if src is not None
+                                    else self.data.round_batches(self.Niter,
+                                                                 clients=rows))
+                                fn, init_fn, N = self._build_round(mdl, ci, px,
+                                                                   py)
+                                if z is None:
+                                    z = stage_global(
+                                        np.zeros((N,), np.float32),
+                                        replicated_sharding(self.mesh))
+                                    opt_state = init_fn(state)
+                                staged = stage_client_rows(batch, csh)
+                                t_staged = time.perf_counter()
+                                state, z, opt_state, dual, losses = fn(
+                                    state, z, opt_state, staged)
+                                rec = dict(nloop=nloop, model=mdl, block=ci,
+                                           nadmm=nadmm, N=N,
+                                           dual_residual=float(dual),
+                                           loss=float(np.sum(fetch(losses))),
+                                           # dense f32 block payload from all
+                                           # K clients (schema parity with
+                                           # the classifier engine; CPC has
+                                           # no compression path yet)
+                                           bytes_on_wire=4 * N * self.K)
+                                # the float()/fetch above force a device sync,
+                                # so the stage/compute split is honest
+                                t_done = time.perf_counter()
+                                rec["stage_seconds"] = t_staged - t_round
+                                rec["compute_seconds"] = t_done - t_staged
+                                rec["round_seconds"] = t_done - t_round
+                                history.append(rec)
+                                if obs.enabled:
+                                    obs.round(dict(
+                                        rec, round_index=len(history) - 1,
+                                        bytes_dense=4 * N * self.K,
+                                        **device_memory_stats()))
+                                if checkpoint_path is not None:
+                                    if nadmm + 1 < Nadmm:
+                                        nxt = (nloop, mdl_i, ci, nadmm + 1)
+                                    elif ci + 1 < len(blocks):
+                                        nxt = (nloop, mdl_i, ci + 1, 0)
+                                    elif mdl_i + 1 < len(SUBMODELS):
+                                        nxt = (nloop, mdl_i + 1, 0, 0)
+                                    else:
+                                        nxt = (nloop + 1, 0, 0, 0)
+                                    self._save_midrun(checkpoint_path, state, z,
+                                                      opt_state, px, py, nxt,
+                                                      history)
+                                log(f"dual (N={N},loop={nloop},model={mdl},"
+                                    f"block={ci},avg={nadmm})="
+                                    f"{rec['dual_residual']:e} "
+                                    f"loss={rec['loss']:e}")
+        except BaseException:
+            obs.close(status="aborted")
+            raise
         finally:
             if src is not None:
                 src.close()
+        obs.close()
         return state, history

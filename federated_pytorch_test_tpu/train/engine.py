@@ -36,6 +36,7 @@ from jax.sharding import PartitionSpec as P
 from federated_pytorch_test_tpu.compress import make_compressor, stacked_init
 from federated_pytorch_test_tpu.data.cifar10 import FederatedCifar10
 from federated_pytorch_test_tpu.models.base import BlockModule
+from federated_pytorch_test_tpu.obs import device_memory_stats
 from federated_pytorch_test_tpu.optim.lbfgs import LBFGSNew
 from federated_pytorch_test_tpu.parallel.mesh import (
     CLIENT_AXIS,
@@ -59,7 +60,7 @@ from federated_pytorch_test_tpu.train.losses import accuracy_count, cross_entrop
 from federated_pytorch_test_tpu.utils import blocks as blocklib
 from federated_pytorch_test_tpu.utils import codec
 from federated_pytorch_test_tpu.utils.initializers import init_weights
-from federated_pytorch_test_tpu.utils.profiling import profile_ctx
+from federated_pytorch_test_tpu.utils.profiling import profile_ctx, round_trace
 
 
 class ClientState(NamedTuple):
@@ -98,6 +99,10 @@ class BlockwiseFederatedTrainer:
     #: unfreeze_one_layer path (federated_vae.py:129)
     sweep: str = "blocks"
 
+    #: engine tag in every obs record (subclasses override: "vae",
+    #: "vae_cl"; the CPC trainer reports "cpc")
+    obs_engine: str = "classifier"
+
     def sample_init_args(self):
         """Args after rng for ``model.init`` (overridden by rng-taking models)."""
         return (jnp.zeros((1, 32, 32, 3), jnp.float32),)
@@ -116,6 +121,11 @@ class BlockwiseFederatedTrainer:
         self.data = data
         self.algo = algorithm
         self.loss_fn = loss_fn
+        # observability (obs/): the last RunRecorder this trainer opened
+        # (tests read .memory off it); drivers set obs_run_name to their
+        # prog name so the JSONL artifact is predictably named
+        self.obs_recorder = None
+        self.obs_run_name: Optional[str] = None
         # update compression (compress/): validated here so a bad flag
         # combination fails at construction, not mid-run inside jit
         self.compressor = make_compressor(
@@ -997,6 +1007,42 @@ class BlockwiseFederatedTrainer:
         (shared helper, utils/profiling.py)."""
         return profile_ctx(self.cfg.profile_dir)
 
+    def _open_obs(self, *, resumed: bool, rounds_prior: int):
+        """Open a RunRecorder for this run (obs/): emits the run-header
+        event (config snapshot, mesh shape, jax/backend versions, git
+        rev) and is fed one schema-validated record per comm round.
+
+        Sinks come from ``cfg.obs_sinks``/``cfg.obs_dir`` ("auto"+None
+        resolves to no sinks, so bare engine-API runs stay file-free and
+        the recorder is a no-op — emission is host-side at round
+        boundaries either way, never inside jitted code).
+        """
+        import dataclasses as _dc
+
+        from federated_pytorch_test_tpu.obs import make_recorder
+
+        cfg = self.cfg
+        run_name = (self.obs_run_name
+                    or f"{self.obs_engine}_{self.algo.name}")
+        rec = make_recorder(
+            getattr(cfg, "obs_sinks", "auto"), getattr(cfg, "obs_dir", None),
+            run_name=run_name, engine=self.obs_engine,
+            algorithm=self.algo.name)
+        rec.open(config=_dc.asdict(cfg), mesh_shape=dict(self.mesh.shape),
+                 resumed=resumed, rounds_prior=rounds_prior)
+        self.obs_recorder = rec
+        return rec
+
+    def _obs_epoch_images(self) -> int:
+        """Images processed per LOCAL EPOCH across all clients
+        (bench.py's convention: K * steps * batch, wrap-padding
+        included); a comm round covers cfg.Nepoch of these."""
+        steps = getattr(self.data, "steps", None)
+        batch = getattr(self.data, "batch", None)
+        if not steps or not batch:
+            return 0
+        return int(self.cfg.K * steps * batch)
+
     def close(self):
         """Stop the epoch-staging worker and drop any in-flight prefetch.
 
@@ -1024,8 +1070,12 @@ class BlockwiseFederatedTrainer:
         except BaseException:
             # an aborted nest leaves a pending prefetch + live worker; the
             # trainer is done either way, so release them (close is the
-            # documented terminal state — _stage_epoch stops prefetching)
+            # documented terminal state — _stage_epoch stops prefetching).
+            # The obs stream gets its summary event too, flagged aborted
+            # (idempotent: a no-op if the run closed it normally)
             self.close()
+            if self.obs_recorder is not None:
+                self.obs_recorder.close(status="aborted")
             raise
 
     def _run_impl(
@@ -1080,6 +1130,9 @@ class BlockwiseFederatedTrainer:
                     "no valid mid-run checkpoint slot survives: "
                     + "; ".join(failures))
 
+        obs = self._open_obs(resumed=resume_at is not None,
+                             rounds_prior=len(history))
+        obs_images = cfg.Nepoch * self._obs_epoch_images()
         for nloop in range(cfg.Nloop):
             for ci in range(self.L):
                 if resume_at is not None and (nloop, ci) < resume_at[:2]:
@@ -1120,130 +1173,160 @@ class BlockwiseFederatedTrainer:
                     self._guard_scale = float("inf")
 
                 for nadmm in range(nadmm_start, cfg.Nadmm):
-                    t_round = time.perf_counter()
-                    active, comm_active, corrupt, comm_host, fcounts = \
-                        self._round_activity(nloop, ci, nadmm)
-                    n_comm = fcounts.pop("n_comm", 1)
-                    q_start = (int(np.sum(self._quarantine > 0))
-                               if cfg.update_guard else 0)
-                    loss_acc = None       # on-device [K] accumulator: the
-                    stage_s = 0.0         # host fetch happens ONCE per round
-                    for nepoch in range(cfg.Nepoch):
-                        t_stage = time.perf_counter()
-                        xb, yb, wb = self._stage_epoch(
-                            last=(nloop == cfg.Nloop - 1
-                                  and ci == self.L - 1
-                                  and nadmm == cfg.Nadmm - 1
-                                  and nepoch == cfg.Nepoch - 1))
-                        keys = self._epoch_keys()
-                        stage_s += time.perf_counter() - t_stage
-                        state, losses = train_epoch(
-                            state, y, self.client_norm, keys,
-                            xb, yb, wb, z, rho, active)
-                        loss_acc = (losses if loss_acc is None
-                                    else loss_acc + losses)
-                        if cfg.be_verbose:
-                            # per-client epoch losses (the reference's
-                            # be_verbose minibatch prints,
-                            # federated_multi.py:199-200) — the only path
-                            # that syncs the host inside the epoch loop
-                            log(f"verbose: block={ci} nadmm={nadmm} "
-                                f"epoch={nepoch} client_loss="
-                                + np.array2string(fetch(losses),
-                                                  precision=4))
-                    if algo.communicates and n_comm > 0:
-                        if cfg.bb_update and nadmm == 0:
-                            mode = "bb_store"
-                        elif (cfg.bb_update and nadmm > 0
-                              and nadmm % cfg.bb_period_T == 0):
-                            mode = "bb"
+                    # one XProf step per comm round, keyed on the
+                    # global round index == the obs round_index, so
+                    # trace steps line up 1:1 with the JSONL records
+                    with round_trace(len(history),
+                                     enabled=cfg.profile_dir is not None):
+                        t_round = time.perf_counter()
+                        active, comm_active, corrupt, comm_host, fcounts = \
+                            self._round_activity(nloop, ci, nadmm)
+                        n_comm = fcounts.pop("n_comm", 1)
+                        q_start = (int(np.sum(self._quarantine > 0))
+                                   if cfg.update_guard else 0)
+                        loss_acc = None       # on-device [K] accumulator: the
+                        stage_s = 0.0         # host fetch happens ONCE per round
+                        t_train = time.perf_counter()
+                        for nepoch in range(cfg.Nepoch):
+                            t_stage = time.perf_counter()
+                            xb, yb, wb = self._stage_epoch(
+                                last=(nloop == cfg.Nloop - 1
+                                      and ci == self.L - 1
+                                      and nadmm == cfg.Nadmm - 1
+                                      and nepoch == cfg.Nepoch - 1))
+                            keys = self._epoch_keys()
+                            stage_s += time.perf_counter() - t_stage
+                            state, losses = train_epoch(
+                                state, y, self.client_norm, keys,
+                                xb, yb, wb, z, rho, active)
+                            loss_acc = (losses if loss_acc is None
+                                        else loss_acc + losses)
+                            if cfg.be_verbose:
+                                # per-client epoch losses (the reference's
+                                # be_verbose minibatch prints,
+                                # federated_multi.py:199-200) — the only path
+                                # that syncs the host inside the epoch loop
+                                log(f"verbose: block={ci} nadmm={nadmm} "
+                                    f"epoch={nepoch} client_loss="
+                                    + np.array2string(fetch(losses),
+                                                      precision=4))
+                        # obs phase segments: wall-clock between host syncs.
+                        # With the single per-round sync, queued device
+                        # compute attributes to the segment containing that
+                        # sync (comm_seconds when communicating, else
+                        # sync_seconds) — see README "Observability"
+                        train_s = time.perf_counter() - t_train - stage_s
+                        t_comm = time.perf_counter()
+                        if algo.communicates and n_comm > 0:
+                            if cfg.bb_update and nadmm == 0:
+                                mode = "bb_store"
+                            elif (cfg.bb_update and nadmm > 0
+                                  and nadmm % cfg.bb_period_T == 0):
+                                mode = "bb"
+                            else:
+                                mode = "plain"
+                            out = comm_fns[mode](
+                                state, z, y, rho, x0, yhat0, comm_active,
+                                corrupt, self._round_gbound())
+                            if cfg.update_guard:
+                                state, z, y, rho, x0, yhat0, diag, okf = out
+                            else:
+                                state, z, y, rho, x0, yhat0, diag = out
+                            diag = {k: float(v) for k, v in diag.items()}
+                            if cfg.update_guard:
+                                # quarantine this round's offenders (active AND
+                                # rejected — okf alone cannot tell a rejected
+                                # client from one that never participated),
+                                # tick running sentences down one round, and
+                                # fold the accepted delta-norm scale into the
+                                # guard bound (EMA; first clean round seeds it)
+                                okf_h = np.asarray(fetch(okf))
+                                tripped = (comm_host > 0) & (okf_h < 0.5)
+                                self._quarantine = np.maximum(
+                                    self._quarantine - 1, 0)
+                                if cfg.quarantine_rounds > 0:
+                                    self._quarantine[tripped] = \
+                                        cfg.quarantine_rounds
+                                if diag.get("n_ok", 0.0) > 0:
+                                    nm = diag["guard_norm_mean"]
+                                    self._guard_scale = (
+                                        nm
+                                        if not np.isfinite(self._guard_scale)
+                                        else 0.5 * self._guard_scale + 0.5 * nm)
+                        elif algo.communicates:
+                            # every client dropped/quarantined out of the
+                            # exchange: degrade gracefully — no collective runs,
+                            # z/y/rho carry over unchanged and the round is
+                            # still recorded (and still serves quarantine time)
+                            diag = {"n_active": 0.0}
+                            if cfg.update_guard:
+                                diag.update(guard_trips=0.0, n_ok=0.0)
+                                self._quarantine = np.maximum(
+                                    self._quarantine - 1, 0)
                         else:
-                            mode = "plain"
-                        out = comm_fns[mode](
-                            state, z, y, rho, x0, yhat0, comm_active,
-                            corrupt, self._round_gbound())
-                        if cfg.update_guard:
-                            state, z, y, rho, x0, yhat0, diag, okf = out
-                        else:
-                            state, z, y, rho, x0, yhat0, diag = out
-                        diag = {k: float(v) for k, v in diag.items()}
-                        if cfg.update_guard:
-                            # quarantine this round's offenders (active AND
-                            # rejected — okf alone cannot tell a rejected
-                            # client from one that never participated),
-                            # tick running sentences down one round, and
-                            # fold the accepted delta-norm scale into the
-                            # guard bound (EMA; first clean round seeds it)
-                            okf_h = np.asarray(fetch(okf))
-                            tripped = (comm_host > 0) & (okf_h < 0.5)
-                            self._quarantine = np.maximum(
-                                self._quarantine - 1, 0)
-                            if cfg.quarantine_rounds > 0:
-                                self._quarantine[tripped] = \
-                                    cfg.quarantine_rounds
-                            if diag.get("n_ok", 0.0) > 0:
-                                nm = diag["guard_norm_mean"]
-                                self._guard_scale = (
-                                    nm
-                                    if not np.isfinite(self._guard_scale)
-                                    else 0.5 * self._guard_scale + 0.5 * nm)
-                    elif algo.communicates:
-                        # every client dropped/quarantined out of the
-                        # exchange: degrade gracefully — no collective runs,
-                        # z/y/rho carry over unchanged and the round is
-                        # still recorded (and still serves quarantine time)
-                        diag = {"n_active": 0.0}
-                        if cfg.update_guard:
-                            diag.update(guard_trips=0.0, n_ok=0.0)
-                            self._quarantine = np.maximum(
-                                self._quarantine - 1, 0)
-                    else:
-                        diag = {}
-                    # single host sync per round: the loss fetch depends on
-                    # every epoch in the chain and the diag/rho floats on
-                    # the collective, so round_seconds (taken after both)
-                    # covers the device compute honestly.  stage_seconds
-                    # isolates host shuffle + H2D copy — with the epoch
-                    # prefetch it should stay near zero unless the host
-                    # pipeline is the bottleneck
-                    loss_sum = (float(np.sum(fetch(loss_acc)))
-                                if loss_acc is not None else 0.0)
-                    rec = dict(nloop=nloop, block=ci, nadmm=nadmm, N=N,
-                               loss=loss_sum, rho=float(rho),
-                               round_seconds=time.perf_counter() - t_round,
-                               stage_seconds=stage_s,
-                               **fcounts, **diag)
-                    if cfg.update_guard and algo.communicates:
-                        # quarantine census at round START (who sat this
-                        # round out), next to the guard_trips the round
-                        # itself produced
-                        rec["quarantined"] = q_start
-                    if algo.communicates:
-                        rec["bytes_on_wire"] = self.round_bytes_on_wire(
-                            N, diag.get("n_active", cfg.K))
-                    if cfg.check_results:
-                        rec["accuracy"] = self.evaluate(state)
-                    history.append(rec)
-                    if checkpoint_path is not None:
-                        if nadmm + 1 < cfg.Nadmm:
-                            nxt = (nloop, ci, nadmm + 1)
-                        elif ci + 1 < self.L:
-                            nxt = (nloop, ci + 1, 0)
-                        else:
-                            nxt = (nloop + 1, 0, 0)
-                        self._save_midrun(checkpoint_path, state,
-                                          (z, y, rho, x0, yhat0), nxt,
-                                          history)
-                    blk = self.block_ids[ci]
-                    msg = (f"block=[{blk[0]},{blk[1]}]({N},{float(rho):f}) "
-                           f"round={nadmm}/{nloop} "
-                           + " ".join(f"{k}={v:e}" for k, v in diag.items()))
-                    if cfg.check_results:
-                        msg += " acc=" + np.array2string(
-                            rec["accuracy"], precision=2)
-                    log(msg)
-                    if on_round is not None:
-                        on_round(state, rec)
+                            diag = {}
+                        comm_s = time.perf_counter() - t_comm
+                        t_sync = time.perf_counter()
+                        # single host sync per round: the loss fetch depends on
+                        # every epoch in the chain and the diag/rho floats on
+                        # the collective, so round_seconds (taken after both)
+                        # covers the device compute honestly.  stage_seconds
+                        # isolates host shuffle + H2D copy — with the epoch
+                        # prefetch it should stay near zero unless the host
+                        # pipeline is the bottleneck
+                        loss_sum = (float(np.sum(fetch(loss_acc)))
+                                    if loss_acc is not None else 0.0)
+                        sync_s = time.perf_counter() - t_sync
+                        rec = dict(nloop=nloop, block=ci, nadmm=nadmm, N=N,
+                                   loss=loss_sum, rho=float(rho),
+                                   round_seconds=time.perf_counter() - t_round,
+                                   stage_seconds=stage_s,
+                                   train_seconds=train_s,
+                                   comm_seconds=comm_s,
+                                   sync_seconds=sync_s,
+                                   **fcounts, **diag)
+                        if cfg.update_guard and algo.communicates:
+                            # quarantine census at round START (who sat this
+                            # round out), next to the guard_trips the round
+                            # itself produced
+                            rec["quarantined"] = q_start
+                        if algo.communicates:
+                            rec["bytes_on_wire"] = self.round_bytes_on_wire(
+                                N, diag.get("n_active", cfg.K))
+                        if cfg.check_results:
+                            rec["accuracy"] = self.evaluate(state)
+                        history.append(rec)
+                        if obs.enabled:
+                            extra = dict(rec, round_index=len(history) - 1,
+                                         images=obs_images,
+                                         **device_memory_stats())
+                            if algo.communicates:
+                                # dense comparator for the wire bytes: every
+                                # participant's f32 block payload
+                                extra["bytes_dense"] = 4 * N * int(
+                                    diag.get("n_active", cfg.K))
+                            obs.round(extra)
+                        if checkpoint_path is not None:
+                            if nadmm + 1 < cfg.Nadmm:
+                                nxt = (nloop, ci, nadmm + 1)
+                            elif ci + 1 < self.L:
+                                nxt = (nloop, ci + 1, 0)
+                            else:
+                                nxt = (nloop + 1, 0, 0)
+                            self._save_midrun(checkpoint_path, state,
+                                              (z, y, rho, x0, yhat0), nxt,
+                                              history)
+                        blk = self.block_ids[ci]
+                        msg = (f"block=[{blk[0]},{blk[1]}]({N},{float(rho):f}) "
+                               f"round={nadmm}/{nloop} "
+                               + " ".join(f"{k}={v:e}" for k, v in diag.items()))
+                        if cfg.check_results:
+                            msg += " acc=" + np.array2string(
+                                rec["accuracy"], precision=2)
+                        log(msg)
+                        if on_round is not None:
+                            on_round(state, rec)
+        obs.close()
         return state, history
 
     def run_independent(self, state: Optional[ClientState] = None,
@@ -1255,6 +1338,8 @@ class BlockwiseFederatedTrainer:
                 return self._run_independent_impl(state, log)
         except BaseException:
             self.close()
+            if self.obs_recorder is not None:
+                self.obs_recorder.close(status="aborted")
             raise
 
     def _run_independent_impl(self, state, log):
@@ -1268,6 +1353,8 @@ class BlockwiseFederatedTrainer:
                          client_sharding(self.mesh))
         rho = stage_global(np.asarray(cfg.admm_rho0, np.float32),
                            replicated_sharding(self.mesh))
+        obs = self._open_obs(resumed=False, rounds_prior=0)
+        obs_images = self._obs_epoch_images()
         for epoch in range(cfg.Nepoch):
             t_epoch = time.perf_counter()
             state = ClientState(state.params, state.batch_stats,
@@ -1285,4 +1372,10 @@ class BlockwiseFederatedTrainer:
             else:
                 log(f"Epoch {epoch} loss={rec['loss']:e}")
             history.append(rec)
+            if obs.enabled:
+                obs.round(dict(rec, round_index=epoch,
+                               round_seconds=rec["epoch_seconds"],
+                               images=obs_images,
+                               **device_memory_stats()))
+        obs.close()
         return state, history

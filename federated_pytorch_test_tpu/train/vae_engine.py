@@ -31,6 +31,7 @@ class VAETrainer(BlockwiseFederatedTrainer):
     """
 
     sweep = "layers"
+    obs_engine = "vae"
 
     def sample_init_args(self):
         return (jnp.zeros((1, 32, 32, 3), jnp.float32), jax.random.PRNGKey(0))
@@ -69,6 +70,7 @@ class VAECLTrainer(BlockwiseFederatedTrainer):
     * reference default K=1 (federated_vae_cl.py:12).
     """
 
+    obs_engine = "vae_cl"
 
     def sample_init_args(self):
         return (jnp.zeros((1, 32, 32, 3), jnp.float32), jax.random.PRNGKey(0))

@@ -19,3 +19,17 @@ def profile_ctx(profile_dir: Optional[str]):
         return jax.profiler.trace(
             os.path.abspath(os.path.expanduser(profile_dir)))
     return contextlib.nullcontext()
+
+
+def round_trace(step: int, enabled: bool = True, name: str = "comm_round"):
+    """``StepTraceAnnotation`` over one communication round.
+
+    Every engine wraps its per-round body in this keyed on the GLOBAL
+    round index (the obs ``round_index``), so XProf step markers line up
+    1:1 with the JSONL round records.  A nullcontext when ``enabled`` is
+    False (no ``--profile-dir``) keeps the unprofiled path free of
+    TraceMe calls.
+    """
+    if not enabled:
+        return contextlib.nullcontext()
+    return jax.profiler.StepTraceAnnotation(name, step_num=int(step))
