@@ -351,6 +351,10 @@ def _bench_round(trainer, ci, *, reps, with_comm=False, with_staging=False,
     fields = dict(label=label or f"block_{ci}", N=int(N), K=int(K),
                   round_seconds=dt, images=reps * images_per_epoch,
                   nadmm=reps)
+    if trainer._sentinel is not None:
+        # cumulative across the trainer: any growth between sections
+        # means a timed region recompiled mid-measurement
+        fields["jit_retraces"] = trainer._sentinel.retraces
     if with_comm and trainer.algo.communicates:
         fields["bytes_on_wire"] = reps * trainer.round_bytes_on_wire(N, K)
         fields["bytes_dense"] = reps * 4 * N * K
@@ -381,8 +385,12 @@ def _measure(out: dict, progress=lambda: None) -> None:
     K, batch, steps, reps = _bench_scale()
     _open_bench_obs(out)
 
+    # retrace sentinel is free after compile (the counting wrapper only
+    # runs when jit traces) and turns a silent recompile regression into
+    # a visible nonzero jit_retraces field in the artifact
     cfg = FederatedConfig(K=K, default_batch=batch, check_results=False,
-                          use_resnet=True, admm_rho0=0.1, bf16=True)
+                          use_resnet=True, admm_rho0=0.1, bf16=True,
+                          retrace_sentinel=True)
     data = FederatedCifar10(K=K, batch=batch,
                             limit_per_client=steps * batch, limit_test=batch)
     # bf16 conv/dense compute (params, BN and head stay f32) feeds the MXU
@@ -418,6 +426,9 @@ def _measure(out: dict, progress=lambda: None) -> None:
     out["value"] = round(headline, 1)
     out["vs_baseline"] = round(headline / TARGET, 3)
     out["measured"] = True
+    # nonzero here = the headline's timed reps recompiled (perf numbers
+    # then include trace time and are not comparable run-to-run)
+    out["jit_retraces"] = trainer._sentinel.retraces
     progress()
 
     # full-net epoch (the no_consensus driver's path): every parameter
@@ -505,7 +516,11 @@ def _bench_cpc() -> dict:
 
     def rotation():
         t0 = time.perf_counter()
-        _, hist = trainer.run(Nloop=1, Nadmm=1, log=lambda m: None)
+        state, hist = trainer.run(Nloop=1, Nadmm=1, log=lambda m: None)
+        # the run's own per-round fetches sync each round, but the FINAL
+        # round's write-back is still in flight at return: close it out
+        # so the rotation time covers all dispatched work
+        jax.block_until_ready(state)
         return time.perf_counter() - t0, hist
 
     rotation()                       # warm-up: pays the LBFGS compiles

@@ -1,0 +1,26 @@
+"""graftcheck — JAX-aware static analysis + runtime sanitizers.
+
+Static side (``core.py`` + ``rules.py`` + ``lint.py``): an AST lint
+engine with rules targeting the trace-time failure classes that have
+actually bitten this codebase — host syncs inside jitted round loops,
+wall-clock timers around async-dispatched computations, PRNG key reuse,
+Python control flow on traced values, recompilation hazards, and
+missing buffer donation.  Run it as::
+
+    python -m federated_pytorch_test_tpu.analysis.lint \
+        federated_pytorch_test_tpu bench.py
+
+Runtime side (``sanitize.py``): ``jax.experimental.checkify`` wiring
+(NaN/inf + out-of-bounds index checks) and a retrace sentinel for the
+engines, both default-off with the dense path bit-identical — the same
+contract as the compress/faults/obs subsystems.
+"""
+
+from .core import (  # noqa: F401
+    Severity,
+    Finding,
+    Rule,
+    LintEngine,
+    load_baseline,
+    save_baseline,
+)

@@ -1,0 +1,263 @@
+"""Rule framework for graftcheck: findings, suppressions, baselines.
+
+Design:
+
+- A :class:`Rule` inspects one parsed module (:class:`ModuleContext`)
+  and yields :class:`Finding`\\ s.  Rules are pure AST passes — no
+  imports of the linted code, so the linter can run on trees that do
+  not import (and on fixture snippets that would crash at runtime).
+- Per-line suppression: ``# graftlint: disable=JG101`` (comma list, or
+  ``all``) on the flagged line silences the finding.
+- Baseline: a committed JSON file of finding *fingerprints* —
+  ``sha1(path :: rule :: stripped source line)`` — so grandfathered
+  findings survive line drift but resurface when the line changes.
+  The shipped baseline is empty: every finding of the shipped rules
+  was fixed, not baselined.
+- Exit policy: findings at or above the ``fail_on`` severity
+  (default WARNING) that are neither suppressed nor baselined fail the
+  run.  ADVICE findings report but never fail at the default level.
+"""
+
+from __future__ import annotations
+
+import ast
+import enum
+import hashlib
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+
+
+class Severity(enum.IntEnum):
+    """Ordered so ``severity >= fail_on`` is the exit-code test."""
+
+    ADVICE = 10
+    WARNING = 20
+    ERROR = 30
+
+    @classmethod
+    def parse(cls, name: str) -> "Severity":
+        try:
+            return cls[name.upper()]
+        except KeyError:
+            raise ValueError(
+                f"unknown severity {name!r}; expected one of "
+                f"{[s.name.lower() for s in cls]}") from None
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str                 # as given on the command line (relative ok)
+    line: int                 # 1-based
+    col: int                  # 0-based (ast convention)
+    rule_id: str              # "JG101"
+    severity: Severity
+    message: str
+    source_line: str = ""     # stripped text of the flagged line
+
+    def fingerprint(self) -> str:
+        """Stable id for baselining: survives line-number drift, breaks
+        when the flagged line's content changes."""
+        key = f"{self.path}::{self.rule_id}::{self.source_line}"
+        return hashlib.sha1(key.encode("utf-8")).hexdigest()
+
+    def to_json(self) -> Dict[str, object]:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "rule": self.rule_id,
+            "severity": self.severity.name.lower(),
+            "message": self.message,
+            "fingerprint": self.fingerprint(),
+        }
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col + 1}: "
+                f"[{self.rule_id} {self.severity.name.lower()}] "
+                f"{self.message}")
+
+
+@dataclass
+class ModuleContext:
+    """One parsed source file handed to every rule."""
+
+    path: str
+    source: str
+    tree: ast.Module
+    lines: List[str] = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.lines:
+            self.lines = self.source.splitlines()
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+class Rule:
+    """Base class: subclasses set ``id``/``severity`` and implement
+    :meth:`check`."""
+
+    id: str = "JG000"
+    severity: Severity = Severity.WARNING
+    summary: str = ""
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, module: ModuleContext, node: ast.AST,
+                message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(path=module.path, line=line, col=col,
+                       rule_id=self.id, severity=self.severity,
+                       message=message,
+                       source_line=module.line_text(line))
+
+
+# --------------------------------------------------------------- suppression
+
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+def suppressed_rules_by_line(source: str) -> Dict[int, Set[str]]:
+    """Map 1-based line -> set of rule ids (or {"all"}) disabled there."""
+    out: Dict[int, Set[str]] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            ids = {t.strip() for t in m.group(1).split(",") if t.strip()}
+            out[i] = {t.lower() if t.lower() == "all" else t.upper()
+                      for t in ids}
+    return out
+
+
+def is_suppressed(finding: Finding,
+                  suppressions: Dict[int, Set[str]]) -> bool:
+    ids = suppressions.get(finding.line)
+    if not ids:
+        return False
+    return "all" in ids or finding.rule_id in ids
+
+
+# ----------------------------------------------------------------- baseline
+
+BASELINE_VERSION = 1
+
+
+def load_baseline(path: Path) -> Set[str]:
+    data = json.loads(Path(path).read_text())
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path}: version {data.get('version')!r} "
+            f"!= {BASELINE_VERSION}")
+    fps = data.get("findings", [])
+    if not isinstance(fps, list):
+        raise ValueError(f"baseline {path}: 'findings' must be a list")
+    return set(fps)
+
+
+def save_baseline(path: Path, findings: Iterable[Finding]) -> None:
+    fps = sorted({f.fingerprint() for f in findings})
+    Path(path).write_text(json.dumps(
+        {"version": BASELINE_VERSION, "findings": fps}, indent=2) + "\n")
+
+
+# ------------------------------------------------------------------- engine
+
+@dataclass
+class LintResult:
+    findings: List[Finding]           # reportable (not suppressed/baselined)
+    suppressed: int = 0
+    baselined: int = 0
+
+    def failing(self, fail_on: Severity = Severity.WARNING) -> List[Finding]:
+        return [f for f in self.findings if f.severity >= fail_on]
+
+
+class LintEngine:
+    """Runs a rule set over files/trees and applies the filtering
+    pipeline (syntax -> rules -> suppressions -> baseline)."""
+
+    def __init__(self, rules: Sequence[Rule],
+                 baseline: Optional[Set[str]] = None):
+        self.rules = list(rules)
+        self.baseline = baseline or set()
+
+    def lint_source(self, source: str, path: str) -> LintResult:
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as exc:
+            f = Finding(path=path, line=exc.lineno or 1,
+                        col=(exc.offset or 1) - 1, rule_id="JG000",
+                        severity=Severity.ERROR,
+                        message=f"syntax error: {exc.msg}",
+                        source_line="")
+            return LintResult(findings=[f])
+        module = ModuleContext(path=path, source=source, tree=tree)
+        suppressions = suppressed_rules_by_line(source)
+        kept: List[Finding] = []
+        n_sup = n_base = 0
+        for rule in self.rules:
+            for finding in rule.check(module):
+                if is_suppressed(finding, suppressions):
+                    n_sup += 1
+                elif finding.fingerprint() in self.baseline:
+                    n_base += 1
+                else:
+                    kept.append(finding)
+        kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
+        return LintResult(findings=kept, suppressed=n_sup, baselined=n_base)
+
+    def lint_file(self, path: Path) -> LintResult:
+        return self.lint_source(Path(path).read_text(), str(path))
+
+    def lint_paths(self, paths: Sequence[str]) -> LintResult:
+        findings: List[Finding] = []
+        n_sup = n_base = 0
+        for p in sorted(expand_paths(paths)):
+            res = self.lint_file(p)
+            findings.extend(res.findings)
+            n_sup += res.suppressed
+            n_base += res.baselined
+        return LintResult(findings=findings, suppressed=n_sup,
+                          baselined=n_base)
+
+
+def expand_paths(paths: Sequence[str]) -> List[Path]:
+    """Files as-is; directories recurse to ``*.py``."""
+    out: List[Path] = []
+    for p in paths:
+        pp = Path(p)
+        if pp.is_dir():
+            out.extend(sorted(pp.rglob("*.py")))
+        else:
+            out.append(pp)
+    return out
+
+
+# ----------------------------------------------------------------- reporting
+
+def render_text(result: LintResult, fail_on: Severity) -> str:
+    lines = [f.render() for f in result.findings]
+    n_fail = len(result.failing(fail_on))
+    lines.append(
+        f"graftcheck: {len(result.findings)} finding(s) "
+        f"({n_fail} at/above {fail_on.name.lower()}), "
+        f"{result.suppressed} suppressed, {result.baselined} baselined")
+    return "\n".join(lines)
+
+
+def render_json(result: LintResult, fail_on: Severity) -> str:
+    return json.dumps({
+        "findings": [f.to_json() for f in result.findings],
+        "suppressed": result.suppressed,
+        "baselined": result.baselined,
+        "failing": len(result.failing(fail_on)),
+        "fail_on": fail_on.name.lower(),
+    }, indent=2)

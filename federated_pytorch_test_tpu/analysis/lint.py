@@ -1,0 +1,78 @@
+"""graftcheck CLI.
+
+Usage::
+
+    python -m federated_pytorch_test_tpu.analysis.lint \
+        federated_pytorch_test_tpu bench.py [--json] \
+        [--baseline analysis/baseline.json] [--write-baseline PATH] \
+        [--fail-on {error,warning,advice}]
+
+Exit code 0 when no non-suppressed, non-baselined finding is at or
+above ``--fail-on`` (default: warning — ADVICE findings report but do
+not fail); 1 otherwise; 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import Optional, Sequence
+
+from .core import (LintEngine, Severity, load_baseline, render_json,
+                   render_text, save_baseline)
+from .rules import ALL_RULES
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m federated_pytorch_test_tpu.analysis.lint",
+        description="JAX-aware static analysis for the federated stack")
+    p.add_argument("paths", nargs="+",
+                   help="files or directories (directories recurse to *.py)")
+    p.add_argument("--json", action="store_true",
+                   help="emit findings as JSON instead of text")
+    p.add_argument("--baseline", type=Path, default=None,
+                   help="JSON baseline of grandfathered finding "
+                        "fingerprints to ignore")
+    p.add_argument("--write-baseline", type=Path, default=None,
+                   help="write current findings as the new baseline "
+                        "and exit 0")
+    p.add_argument("--fail-on", default="warning",
+                   choices=["error", "warning", "advice"],
+                   help="minimum severity that fails the run "
+                        "(default: warning)")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    fail_on = Severity.parse(args.fail_on)
+    baseline = None
+    if args.baseline is not None:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError) as exc:
+            print(f"graftcheck: cannot read baseline: {exc}",
+                  file=sys.stderr)
+            return 2
+    missing = [p for p in args.paths if not Path(p).exists()]
+    if missing:
+        print(f"graftcheck: no such path(s): {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    engine = LintEngine(ALL_RULES, baseline=baseline)
+    result = engine.lint_paths(args.paths)
+    if args.write_baseline is not None:
+        save_baseline(args.write_baseline, result.findings)
+        print(f"graftcheck: wrote {len(result.findings)} fingerprint(s) "
+              f"to {args.write_baseline}")
+        return 0
+    out = (render_json(result, fail_on) if args.json
+           else render_text(result, fail_on))
+    print(out)
+    return 1 if result.failing(fail_on) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

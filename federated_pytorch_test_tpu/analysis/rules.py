@@ -1,0 +1,738 @@
+"""graftcheck rule set (JG101-JG106).
+
+All rules share one per-module :class:`JitIndex` that answers "which
+functions execute under a jit trace, and which of their parameters are
+static there".  Jit contexts are found syntactically:
+
+- ``jax.jit(fn, ...)`` call sites, resolving ``fn`` through
+  ``shard_map(fn, ...)`` wrappers and ``functools.partial(fn, kw=...)``
+  (partial-bound kwargs become *static* parameters — they are baked
+  into the traced callable, not traced);
+- ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorators;
+- functions lexically nested inside either of the above.
+
+Cross-function traced-value flow (a traced array passed into a helper
+defined elsewhere) is out of scope for this pass — see the ROADMAP
+open item.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, ModuleContext, Rule, Severity
+
+FunctionNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+ScopeNode = FunctionNode + (ast.Module,)
+_BRANCHY = (ast.If, ast.While, ast.For, ast.AsyncFor, ast.Try)
+
+_TIMER_FUNCS = {"perf_counter", "monotonic", "time", "process_time"}
+_SYNC_NAMES = {"block_until_ready", "device_get", "item", "tolist",
+               "asarray"}
+_ARRAY_CTORS = {"array", "asarray", "zeros", "ones", "full", "arange",
+                "linspace", "eye"}
+_STATE_PARAMS = {"state", "opt_state", "params", "carry"}
+_SAMPLER_EXEMPT = {"split", "fold_in", "PRNGKey", "key", "key_data",
+                   "wrap_key_data", "clone"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _last_name(node: ast.AST) -> Optional[str]:
+    d = _dotted(node)
+    return d.rsplit(".", 1)[-1] if d else None
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    """jax.jit / pjit call sites, plus local wrappers that follow the
+    ``*_jit(fn, ...)`` naming convention (e.g. the engines'
+    ``_instrument_jit``) — otherwise instrumentation helpers would hide
+    the step functions from every jit-context rule."""
+    d = _dotted(call.func)
+    if not d:
+        return False
+    last = d.rsplit(".", 1)[-1]
+    return d in ("jit", "jax.jit") or last == "pjit" \
+        or last.endswith("_jit")
+
+
+def _is_partial_call(call: ast.Call) -> bool:
+    return _last_name(call.func) == "partial"
+
+
+def _is_timer_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = _dotted(node.func)
+    if not d:
+        return False
+    last = d.rsplit(".", 1)[-1]
+    if last not in _TIMER_FUNCS:
+        return False
+    # bare time() must come from the time module to count
+    return last != "time" or d in ("time", "time.time")
+
+
+@dataclass
+class JitSite:
+    """One jax.jit(...) call or @jit decorator."""
+
+    call: Optional[ast.Call]          # None for bare @jax.jit decorators
+    node: ast.AST                     # node to anchor findings on
+    fn: Optional[ast.AST]             # resolved wrapped FunctionDef
+    static_params: Set[str] = field(default_factory=set)
+    donates: bool = False
+    static_argnums: Tuple[int, ...] = ()
+    bound_name: Optional[str] = None  # `f = jax.jit(...)` binding, if any
+
+
+@dataclass
+class JitIndex:
+    parents: Dict[ast.AST, ast.AST]
+    sites: List[JitSite]
+    contexts: Set[ast.AST]                       # FunctionDefs under jit
+    static_by_fn: Dict[ast.AST, Set[str]]        # root fn -> static params
+    numpy_aliases: Set[str]
+    jitted_bindings: Dict[str, JitSite]
+
+    def enclosing_fn(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None and not isinstance(cur, FunctionNode):
+            cur = self.parents.get(cur)
+        return cur
+
+    def in_jit_context(self, node: ast.AST) -> bool:
+        fn = self.enclosing_fn(node)
+        while fn is not None:
+            if fn in self.contexts:
+                return True
+            fn = self.enclosing_fn(fn)
+        return False
+
+
+def _build_parents(tree: ast.Module) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _enclosing_scope(parents, node) -> ast.AST:
+    cur = parents.get(node)
+    while cur is not None and not isinstance(cur, ScopeNode):
+        cur = parents.get(cur)
+    return cur
+
+
+def _resolve_callable(expr: ast.AST, scope: ast.AST, parents,
+                      fn_by_scope) -> Tuple[Optional[ast.AST], Set[str], int]:
+    """Resolve the callable passed to jit to a local FunctionDef.
+
+    Returns (fn_node_or_None, partial-bound kwarg names, count of
+    partial-bound positionals).  Sees through shard_map(...) and
+    functools.partial(...).
+    """
+    if isinstance(expr, ast.Name):
+        cur = scope
+        while cur is not None:
+            fn = fn_by_scope.get((cur, expr.id))
+            if fn is not None:
+                return fn, set(), 0
+            cur = _enclosing_scope(parents, cur)
+        return None, set(), 0
+    if isinstance(expr, ast.Call) and expr.args:
+        last = _last_name(expr.func)
+        if last == "shard_map":
+            return _resolve_callable(expr.args[0], scope, parents,
+                                     fn_by_scope)
+        if _is_partial_call(expr):
+            fn, kws, pos = _resolve_callable(expr.args[0], scope, parents,
+                                             fn_by_scope)
+            kws = kws | {k.arg for k in expr.keywords if k.arg}
+            return fn, kws, pos + len(expr.args) - 1
+    return None, set(), 0
+
+
+def _const_tuple_ints(node: ast.AST) -> Tuple[int, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                out.append(el.value)
+        return tuple(out)
+    return ()
+
+
+def _const_strs(node: ast.AST) -> Tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(el.value for el in node.elts
+                     if isinstance(el, ast.Constant)
+                     and isinstance(el.value, str))
+    return ()
+
+
+def _fn_param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args]
+    return names
+
+
+def build_index(module: ModuleContext) -> JitIndex:
+    cached = getattr(module, "_graft_index", None)
+    if cached is not None:
+        return cached
+    tree = module.tree
+    parents = _build_parents(tree)
+
+    numpy_aliases: Set[str] = set()
+    jnp_aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for al in node.names:
+                if al.name == "numpy":
+                    numpy_aliases.add(al.asname or "numpy")
+                if al.name == "jax.numpy":
+                    jnp_aliases.add(al.asname or "jax.numpy")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax" and any(a.name == "numpy"
+                                            for a in node.names):
+                for a in node.names:
+                    if a.name == "numpy":
+                        jnp_aliases.add(a.asname or "numpy")
+
+    # (scope node, name) -> FunctionDef defined directly in that scope
+    fn_by_scope: Dict[Tuple[ast.AST, str], ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, FunctionNode):
+            fn_by_scope[(_enclosing_scope(parents, node), node.name)] = node
+
+    sites: List[JitSite] = []
+    jitted_bindings: Dict[str, JitSite] = {}
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_call(node) and node.args:
+            scope = _enclosing_scope(parents, node)
+            fn, static_kw, _ = _resolve_callable(node.args[0], scope,
+                                                 parents, fn_by_scope)
+            static = set(static_kw)
+            argnums: Tuple[int, ...] = ()
+            donates = False
+            for kw in node.keywords:
+                if kw.arg in ("static_argnums", "static_argnposnums"):
+                    argnums = _const_tuple_ints(kw.value)
+                elif kw.arg == "static_argnames":
+                    static |= set(_const_strs(kw.value))
+                elif kw.arg in ("donate_argnums", "donate_argnames"):
+                    donates = True
+            if fn is not None:
+                names = _fn_param_names(fn)
+                for i in argnums:
+                    if 0 <= i < len(names):
+                        static.add(names[i])
+            site = JitSite(call=node, node=node, fn=fn,
+                           static_params=static, donates=donates,
+                           static_argnums=argnums)
+            sites.append(site)
+            parent = parents.get(node)
+            if (isinstance(parent, ast.Assign) and len(parent.targets) == 1
+                    and isinstance(parent.targets[0], ast.Name)):
+                site.bound_name = parent.targets[0].id
+                jitted_bindings[site.bound_name] = site
+
+    # decorator forms: @jax.jit / @jit / @partial(jax.jit, ...)
+    for node in ast.walk(tree):
+        if not isinstance(node, FunctionNode):
+            continue
+        for dec in node.decorator_list:
+            static: Set[str] = set()
+            argnums = ()
+            donates = False
+            is_jit = False
+            if _dotted(dec) in ("jit", "jax.jit"):
+                is_jit = True
+            elif isinstance(dec, ast.Call):
+                if _is_jit_call(dec):
+                    is_jit, call = True, dec
+                elif (_is_partial_call(dec) and dec.args
+                      and _dotted(dec.args[0]) in ("jit", "jax.jit")):
+                    is_jit, call = True, dec
+                if is_jit:
+                    for kw in dec.keywords:
+                        if kw.arg == "static_argnums":
+                            argnums = _const_tuple_ints(kw.value)
+                        elif kw.arg == "static_argnames":
+                            static |= set(_const_strs(kw.value))
+                        elif kw.arg in ("donate_argnums", "donate_argnames"):
+                            donates = True
+            if is_jit:
+                names = _fn_param_names(node)
+                for i in argnums:
+                    if 0 <= i < len(names):
+                        static.add(names[i])
+                sites.append(JitSite(
+                    call=dec if isinstance(dec, ast.Call) else None,
+                    node=dec, fn=node, static_params=static,
+                    donates=donates, static_argnums=argnums))
+
+    roots: Dict[ast.AST, Set[str]] = {}
+    for site in sites:
+        if site.fn is not None:
+            roots.setdefault(site.fn, set()).update(site.static_params)
+
+    contexts: Set[ast.AST] = set()
+    for root in roots:
+        contexts.add(root)
+        for sub in ast.walk(root):
+            if isinstance(sub, FunctionNode):
+                contexts.add(sub)
+
+    index = JitIndex(parents=parents, sites=sites, contexts=contexts,
+                     static_by_fn=roots, numpy_aliases=numpy_aliases or
+                     {"numpy", "np", "onp"},
+                     jitted_bindings=jitted_bindings)
+    module._graft_index = index
+    return index
+
+
+def _walk_scope(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body without descending into nested defs
+    (comprehensions and lambdas are treated as part of the scope)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, FunctionNode):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ------------------------------------------------------------------- JG101
+
+class HostSyncInJit(Rule):
+    id = "JG101"
+    severity = Severity.ERROR
+    summary = "host sync / numpy materialisation inside a jitted function"
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        index = build_index(module)
+        if not index.contexts:
+            return
+        np_prefixes = index.numpy_aliases
+        for fn in index.contexts:
+            for node in _walk_scope(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                # x.item() / x.tolist()
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("item", "tolist")
+                        and not node.args):
+                    yield self.finding(
+                        module, node,
+                        f".{node.func.attr}() forces a device->host sync "
+                        "inside a jitted function; return the value and "
+                        "read it outside the trace")
+                    continue
+                d = _dotted(node.func)
+                if d:
+                    head, _, tail = d.rpartition(".")
+                    if head in np_prefixes and tail in ("asarray", "array"):
+                        yield self.finding(
+                            module, node,
+                            f"{d}() materialises a traced value on the host "
+                            "inside a jitted function; use jax.numpy or "
+                            "move the conversion outside jit")
+                        continue
+                    if d in ("jax.device_get", "device_get"):
+                        yield self.finding(
+                            module, node,
+                            f"{d}() inside a jitted function is a host "
+                            "round-trip; fetch outside the trace")
+                        continue
+                if (isinstance(node.func, ast.Name)
+                        and node.func.id in ("float", "int")
+                        and node.args
+                        and not isinstance(node.args[0], ast.Constant)):
+                    yield self.finding(
+                        module, node,
+                        f"{node.func.id}() on a non-literal inside a jitted "
+                        "function concretises a traced value (host sync / "
+                        "TracerConversionError); keep it as an array")
+
+
+# ------------------------------------------------------------------- JG102
+
+class TracedBranch(Rule):
+    id = "JG102"
+    severity = Severity.ERROR
+    summary = "Python control flow on a traced value inside jit"
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        index = build_index(module)
+        if not index.contexts:
+            return
+        for fn in index.contexts:
+            traced = set(_fn_param_names(fn))
+            traced -= index.static_by_fn.get(fn, set())
+            # parameters of enclosing jit contexts are traced here too
+            outer = index.enclosing_fn(fn)
+            while outer is not None:
+                if outer in index.contexts:
+                    traced |= (set(_fn_param_names(outer))
+                               - index.static_by_fn.get(outer, set()))
+                outer = index.enclosing_fn(outer)
+            if not traced:
+                continue
+            for node in _walk_scope(fn):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                test = node.test
+                # `x is None` checks are resolved statically at trace time
+                if (isinstance(test, ast.Compare)
+                        and all(isinstance(op, (ast.Is, ast.IsNot))
+                                for op in test.ops)):
+                    continue
+                hit = next(
+                    (n for n in ast.walk(test)
+                     if isinstance(n, ast.Name)
+                     and isinstance(n.ctx, ast.Load)
+                     and n.id in traced), None)
+                if hit is not None:
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    yield self.finding(
+                        module, node,
+                        f"Python `{kind}` on traced value {hit.id!r} inside "
+                        "a jitted function; use lax.cond/lax.while_loop or "
+                        "jnp.where (or bind the argument statically)")
+
+
+# ------------------------------------------------------------------- JG103
+
+class KeyReuse(Rule):
+    id = "JG103"
+    severity = Severity.WARNING
+    summary = "PRNG key constructed or consumed more than once"
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        index = build_index(module)
+        scopes: List[ast.AST] = [module.tree]
+        for node in ast.walk(module.tree):
+            if isinstance(node, FunctionNode):
+                scopes.append(node)
+        for scope in scopes:
+            yield from self._check_scope(module, scope)
+
+    def _prngkey_calls(self, scope) -> List[ast.Call]:
+        out = []
+        for node in _walk_scope(scope):
+            if (isinstance(node, ast.Call)
+                    and _last_name(node.func) == "PRNGKey"):
+                out.append(node)
+        return out
+
+    def _check_scope(self, module, scope) -> Iterator[Finding]:
+        # (a) the same PRNGKey(<expr>) built twice in one scope
+        by_arg: Dict[str, List[ast.Call]] = {}
+        for call in self._prngkey_calls(scope):
+            key = ast.dump(ast.Module(
+                body=[ast.Expr(a) for a in call.args], type_ignores=[]))
+            by_arg.setdefault(key, []).append(call)
+        for calls in by_arg.values():
+            calls.sort(key=lambda c: (c.lineno, c.col_offset))
+            for dup in calls[1:]:
+                yield self.finding(
+                    module, dup,
+                    "PRNGKey(...) constructed twice from the same seed "
+                    "expression in this scope — both consumers draw the "
+                    "SAME stream; derive the second key via "
+                    "jax.random.fold_in/split")
+        # (b) one key name feeding >= 2 jax.random samplers, never split
+        assigned: Dict[str, ast.AST] = {}
+        for node in _walk_scope(scope):
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)
+                    and _last_name(node.value.func) == "PRNGKey"):
+                assigned[node.targets[0].id] = node
+        if not assigned:
+            return
+        uses: Dict[str, List[ast.Call]] = {k: [] for k in assigned}
+        split_names: Set[str] = set()
+        for node in _walk_scope(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if not d or "random" not in d.split("."):
+                continue
+            tail = d.rsplit(".", 1)[-1]
+            argnames = {a.id for a in node.args if isinstance(a, ast.Name)}
+            for name in argnames & set(assigned):
+                if tail in ("split", "fold_in"):
+                    split_names.add(name)
+                elif tail not in _SAMPLER_EXEMPT:
+                    uses[name].append(node)
+        for name, calls in uses.items():
+            if name in split_names or len(calls) < 2:
+                continue
+            calls.sort(key=lambda c: (c.lineno, c.col_offset))
+            for dup in calls[1:]:
+                yield self.finding(
+                    module, dup,
+                    f"PRNG key {name!r} feeds multiple jax.random "
+                    "consumers without an intervening split/fold_in — "
+                    "the draws are correlated")
+
+
+# ------------------------------------------------------------------- JG104
+
+class TimerNoSync(Rule):
+    id = "JG104"
+    severity = Severity.WARNING
+    summary = "wall-clock timer around dispatched work without a host sync"
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        bodies: List[List[ast.stmt]] = []
+        for node in ast.walk(module.tree):
+            for attr in ("body", "orelse", "finalbody"):
+                blk = getattr(node, attr, None)
+                if isinstance(blk, list) and blk \
+                        and isinstance(blk[0], ast.stmt):
+                    bodies.append(blk)
+        for body in bodies:
+            yield from self._check_block(module, body)
+
+    def _timer_assign(self, stmt) -> Optional[str]:
+        if (isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and _is_timer_call(stmt.value)):
+            return stmt.targets[0].id
+        return None
+
+    def _elapsed_pairs(self, stmt, timers: Dict[str, int]
+                       ) -> List[Tuple[str, Optional[str]]]:
+        """(timer name, minuend-name-or-None) for `X - t` in stmt."""
+        out = []
+        for node in ast.walk(stmt):
+            if (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub)
+                    and isinstance(node.right, ast.Name)
+                    and node.right.id in timers):
+                minuend = None
+                if isinstance(node.left, ast.Name):
+                    minuend = node.left.id
+                elif _is_timer_call(node.left):
+                    minuend = None        # inline perf_counter() read
+                else:
+                    continue              # unrecognised minuend: skip pair
+                out.append((node.right.id, minuend))
+        return out
+
+    def _check_block(self, module, body) -> Iterator[Finding]:
+        timers: Dict[str, int] = {}          # name -> stmt index of assign
+        for i, stmt in enumerate(body):
+            name = self._timer_assign(stmt)
+            if name is not None:
+                timers[name] = i
+                continue
+            if not timers:
+                continue
+            for tname, minuend in self._elapsed_pairs(stmt, timers):
+                start = timers.pop(tname, None)
+                if start is None:
+                    continue
+                if minuend is not None and minuend in timers:
+                    end = timers[minuend]        # region ends at 2nd stamp
+                elif minuend is not None:
+                    continue                     # `x - t` with unknown x
+                else:
+                    end = i
+                region = body[start + 1:end + 1]
+                if not region:
+                    continue
+                has_call = any(isinstance(n, ast.Call)
+                               for s in region for n in ast.walk(s))
+                if not has_call:
+                    continue
+                # a yield in the region means this is a context-manager /
+                # generator timer: it measures the CALLER's code, and the
+                # sync responsibility lives at the call site
+                if any(isinstance(n, (ast.Yield, ast.YieldFrom))
+                       for s in region for n in ast.walk(s)):
+                    continue
+                if not self._region_synced(region):
+                    yield self.finding(
+                        module, body[start],
+                        f"timer {tname!r} measures a region that dispatches "
+                        "work but never syncs the host unconditionally "
+                        "(block_until_ready/fetch/float) before the elapsed "
+                        "read — this times dispatch, not execution")
+
+    def _region_synced(self, region: Sequence[ast.stmt]) -> bool:
+        for stmt in region:
+            if self._stmt_syncs(stmt):
+                return True
+        return False
+
+    def _stmt_syncs(self, stmt: ast.stmt) -> bool:
+        """True if stmt unconditionally reaches a sync marker (markers
+        nested under if/while/for/try don't count; conditional
+        *expressions* do)."""
+        if isinstance(stmt, _BRANCHY):
+            return False
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            if any(self._expr_syncs(it.context_expr)
+                   for it in stmt.items):
+                return True
+            return any(self._stmt_syncs(s) for s in stmt.body)
+        if isinstance(stmt, FunctionNode + (ast.ClassDef,)):
+            return False
+        return self._expr_syncs(stmt)
+
+    def _expr_syncs(self, root: ast.AST) -> bool:
+        stack = [root]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, FunctionNode + (ast.Lambda,)):
+                continue
+            if isinstance(node, ast.Call) and self._is_sync_call(node):
+                return True
+            stack.extend(ast.iter_child_nodes(node))
+        return False
+
+    def _is_sync_call(self, call: ast.Call) -> bool:
+        last = _last_name(call.func)
+        if last is None:
+            return False
+        if last in _SYNC_NAMES or "sync" in last.lower() or last == "fetch":
+            return True
+        if last in ("float", "int") and isinstance(call.func, ast.Name):
+            return bool(call.args) and not isinstance(call.args[0],
+                                                      ast.Constant)
+        # jax.tree.map(np.asarray, x): mapping a fetching function over a
+        # tree is this repo's "force a host fetch" idiom
+        if last in ("map", "tree_map"):
+            return any(_last_name(a) in _SYNC_NAMES for a in call.args)
+        return False
+
+
+# ------------------------------------------------------------------- JG105
+
+class RecompileHazard(Rule):
+    id = "JG105"
+    severity = Severity.WARNING
+    summary = "recompilation hazard: closure array / non-hashable static"
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        index = build_index(module)
+        yield from self._closure_arrays(module, index)
+        yield from self._nonhashable_statics(module, index)
+
+    def _closure_arrays(self, module, index) -> Iterator[Finding]:
+        np_like = index.numpy_aliases | {"jnp", "jax"}
+        for fn in index.contexts:
+            local: Set[str] = set(_fn_param_names(fn))
+            array_outer: Dict[str, int] = {}
+            for node in _walk_scope(fn):
+                if isinstance(node, ast.Name) and isinstance(
+                        node.ctx, (ast.Store, ast.Del)):
+                    local.add(node.id)
+            outer = index.enclosing_fn(fn)
+            while outer is not None:
+                for node in _walk_scope(outer):
+                    if (isinstance(node, ast.Assign)
+                            and len(node.targets) == 1
+                            and isinstance(node.targets[0], ast.Name)
+                            and isinstance(node.value, ast.Call)):
+                        d = _dotted(node.value.func)
+                        if d and "." in d:
+                            head, _, tail = d.rpartition(".")
+                            if head in np_like and tail in _ARRAY_CTORS:
+                                array_outer.setdefault(
+                                    node.targets[0].id, node.lineno)
+                outer = index.enclosing_fn(outer)
+            if not array_outer:
+                continue
+            seen: Set[str] = set()
+            for node in _walk_scope(fn):
+                if (isinstance(node, ast.Name)
+                        and isinstance(node.ctx, ast.Load)
+                        and node.id in array_outer
+                        and node.id not in local
+                        and node.id not in seen):
+                    seen.add(node.id)
+                    yield self.finding(
+                        module, node,
+                        f"jitted function closes over concrete array "
+                        f"{node.id!r} (built at line "
+                        f"{array_outer[node.id]}); a rebuilt closure "
+                        "retraces — pass it as an argument instead")
+
+    def _nonhashable_statics(self, module, index) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = node.func.id if isinstance(node.func, ast.Name) else None
+            site = index.jitted_bindings.get(name) if name else None
+            if site is None or not site.static_argnums:
+                continue
+            for pos in site.static_argnums:
+                if pos < len(node.args) and isinstance(
+                        node.args[pos], (ast.List, ast.Dict, ast.Set)):
+                    yield self.finding(
+                        module, node.args[pos],
+                        f"non-hashable literal at static_argnums position "
+                        f"{pos} of jitted {name!r} — every call retraces "
+                        "(and jax raises on unhashable statics); pass a "
+                        "tuple or hashable config object")
+
+
+# ------------------------------------------------------------------- JG106
+
+class MissingDonation(Rule):
+    id = "JG106"
+    severity = Severity.ADVICE
+    summary = "jitted update fn carries large state but donates no buffers"
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        index = build_index(module)
+        for site in index.sites:
+            if site.donates or site.fn is None:
+                continue
+            params = set(_fn_param_names(site.fn))
+            hit = sorted(params & _STATE_PARAMS)
+            if not hit:
+                continue
+            fn_name = getattr(site.fn, "name", "<fn>")
+            yield self.finding(
+                module, site.node,
+                f"jit of {fn_name!r} updates large state "
+                f"({', '.join(hit)}) without donate_argnums; donating "
+                "would reuse the input buffers in-place on TPU "
+                "(advisory — verify no caller reuses the donated arrays)")
+
+
+ALL_RULES: Sequence[Rule] = (
+    HostSyncInJit(),
+    TracedBranch(),
+    KeyReuse(),
+    TimerNoSync(),
+    RecompileHazard(),
+    MissingDonation(),
+)

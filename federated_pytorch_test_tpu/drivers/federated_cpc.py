@@ -59,6 +59,15 @@ def main(argv=None):
                    action=argparse.BooleanOptionalAction, default=False,
                    help="save a resumable checkpoint every comm round; "
                         "resume with --load-model")
+    p.add_argument("--sanitize", action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="run the jitted CPC round under "
+                        "jax.experimental.checkify (NaN/inf + index "
+                        "checks; debugging mode, adds a per-round sync)")
+    p.add_argument("--retrace-sentinel",
+                   action=argparse.BooleanOptionalAction, default=False,
+                   help="count jit retraces of the round step and emit "
+                        "jit_retraces in the obs round records")
     args = p.parse_args(argv)
 
     from federated_pytorch_test_tpu.drivers.common import setup_runtime
@@ -76,7 +85,9 @@ def main(argv=None):
                          batch_size=args.batch_size,
                          patch_size=args.patch_size, seed=args.seed)
     trainer = CPCTrainer(data, latent_dim=args.Lc, reduced_dim=args.Rc,
-                         Niter=args.Niter, num_devices=args.num_devices)
+                         Niter=args.Niter, num_devices=args.num_devices,
+                         sanitize=args.sanitize,
+                         retrace_sentinel=args.retrace_sentinel)
     print(f"federated_cpc: K={data.K} Lc={args.Lc} Rc={args.Rc} "
           f"devices={trainer.D}")
     state = trainer.state0

@@ -23,7 +23,11 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict
 
-SCHEMA_VERSION = 1
+# v2 (additive): optional per-round `jit_retraces` — cumulative jit
+# retrace count from the engine's retrace sentinel
+# (analysis/sanitize.py), present when --retrace-sentinel is on.
+# v1 records remain valid: validate_record accepts ver <= SCHEMA_VERSION.
+SCHEMA_VERSION = 2
 
 EVENTS = ("run_header", "round", "summary")
 
@@ -90,6 +94,8 @@ FIELDS: Dict[str, Any] = {
     "sync_seconds": (("round",), _NUM),
     "compute_seconds": (("round",), _NUM),
     "epoch_seconds": (("round",), _NUM),
+    # recompilation sentinel (schema v2; --retrace-sentinel)
+    "jit_retraces": (("round",), _INT),
     # communication volume
     "bytes_on_wire": (("round",), _INT),
     "bytes_dense":  (("round",), _INT),

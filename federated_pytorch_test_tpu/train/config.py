@@ -166,3 +166,16 @@ class FederatedConfig:
     # Inspect with: python -m federated_pytorch_test_tpu.obs.report
     obs_dir: Optional[str] = None
     obs_sinks: str = "auto"
+
+    # runtime sanitizers (analysis/sanitize.py) — both default-off, and
+    # with both off the engine builds the literal uninstrumented
+    # jax.jit(shard_map(...)) chain (bit-identical dense path, same
+    # contract as compress/faults/obs):
+    # --sanitize runs the train/comm steps under jax.experimental.checkify
+    # (NaN/inf + out-of-bounds index assertions; errors throw on the host
+    # after each step — a debugging mode, it adds a per-step sync);
+    # --retrace-sentinel counts jit (re)traces of the step functions and
+    # surfaces cumulative `jit_retraces` in the obs round records so
+    # recompilation regressions show up in the perf trajectory.
+    sanitize: bool = False
+    retrace_sentinel: bool = False

@@ -33,6 +33,10 @@ import optax
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from federated_pytorch_test_tpu.analysis.sanitize import (
+    TraceSentinel,
+    instrument_jit,
+)
 from federated_pytorch_test_tpu.compress import make_compressor, stacked_init
 from federated_pytorch_test_tpu.data.cifar10 import FederatedCifar10
 from federated_pytorch_test_tpu.models.base import BlockModule
@@ -199,7 +203,12 @@ class BlockwiseFederatedTrainer:
         rng = jax.random.PRNGKey(cfg.init_seed)
         params, batch_stats = model.init_variables(rng, *self.sample_init_args())
         if cfg.init_model:
-            params = init_weights(params, jax.random.PRNGKey(cfg.init_seed))
+            # SEED COMPAT (graftcheck JG103): init_weights used to rebuild
+            # PRNGKey(cfg.init_seed) and so drew the SAME stream as the
+            # module init above; fold_in gives it a distinct child stream,
+            # which changes init_model=True draws vs earlier releases
+            # (see PARITY.md)
+            params = init_weights(params, jax.random.fold_in(rng, 1))
         self.has_bn = bool(batch_stats)
 
         stack = lambda t: jax.tree.map(
@@ -214,6 +223,11 @@ class BlockwiseFederatedTrainer:
         self.batch_stats0 = stage_tree_global(stack(batch_stats), csh)
 
         self._fn_cache: Dict[Any, Any] = {}
+        # retrace sentinel: counts jit traces of the instrumented step
+        # functions (analysis/sanitize.py); None when off so the step
+        # builders wrap nothing and the jitted chain is literally the
+        # uninstrumented one
+        self._sentinel = TraceSentinel() if cfg.retrace_sentinel else None
         # stateless per-epoch randomness: epochs are keyed on a counter
         # (see _epoch_seed), so the NEXT epoch's host-side shuffle/gather
         # can be built on a worker thread while the devices compute this
@@ -331,6 +345,14 @@ class BlockwiseFederatedTrainer:
     # ------------------------------------------------------------------
     # compiled steps (built per block; cached)
     # ------------------------------------------------------------------
+    def _instrument_jit(self, fn, name: str):
+        """jit ``fn`` with the config's sanitize/retrace instrumentation
+        (analysis/sanitize.py).  With both knobs off — the default —
+        this is exactly ``jax.jit(fn)``: the dense path stays
+        bit-identical by construction."""
+        return instrument_jit(fn, name, sanitize=self.cfg.sanitize,
+                              sentinel=self._sentinel)
+
     def _build_fns(self, ci: Optional[int]):
         """(train_epoch, comm_round, init_opt) specialised to block ``ci``."""
         key = ("blk", ci)
@@ -556,7 +578,7 @@ class BlockwiseFederatedTrainer:
         spec_r = P()
         state_specs = ClientState(spec_c, spec_c, spec_c, spec_c)
 
-        train_epoch = jax.jit(
+        train_epoch = self._instrument_jit(
             shard_map(
                 epoch_shard,
                 mesh=self.mesh,
@@ -564,8 +586,8 @@ class BlockwiseFederatedTrainer:
                           spec_c, spec_r, spec_r, spec_c),
                 out_specs=(state_specs, spec_c),
                 check_vma=False,
-            )
-        )
+            ),
+            f"train_epoch[blk={ci}]")
 
         comm_out = (state_specs, spec_r, spec_c, spec_r, spec_c,
                     spec_c, spec_r)
@@ -573,7 +595,7 @@ class BlockwiseFederatedTrainer:
             comm_out = comm_out + (spec_c,)      # okf verdicts to the host
         comm_fns = {}
         for mode in ("plain", "bb_store", "bb"):
-            comm_fns[mode] = jax.jit(
+            comm_fns[mode] = self._instrument_jit(
                 shard_map(
                     functools.partial(comm_shard, mode=mode),
                     mesh=self.mesh,
@@ -581,8 +603,8 @@ class BlockwiseFederatedTrainer:
                               spec_c, spec_c, spec_c, spec_r),
                     out_specs=comm_out,
                     check_vma=False,
-                )
-            )
+                ),
+                f"comm[{mode},blk={ci}]")
 
         def init_opt(params):
             if use_lbfgs:
@@ -1007,6 +1029,16 @@ class BlockwiseFederatedTrainer:
         (shared helper, utils/profiling.py)."""
         return profile_ctx(self.cfg.profile_dir)
 
+    @staticmethod
+    def _obs_sync(obs, *values):
+        """Close out async dispatch at an obs phase-timing boundary
+        (graftcheck JG104): when obs is recording, the stage/train/comm
+        segment timings must measure execution, not dispatch — see
+        PARITY.md for the timing-semantics change.  No-op with obs off,
+        preserving the single-host-sync-per-round fast path."""
+        if obs.enabled:
+            jax.block_until_ready([v for v in values if v is not None])
+
     def _open_obs(self, *, resumed: bool, rounds_prior: int):
         """Open a RunRecorder for this run (obs/): emits the run-header
         event (config snapshot, mesh shape, jax/backend versions, git
@@ -1195,6 +1227,7 @@ class BlockwiseFederatedTrainer:
                                       and nadmm == cfg.Nadmm - 1
                                       and nepoch == cfg.Nepoch - 1))
                             keys = self._epoch_keys()
+                            self._obs_sync(obs, xb, yb, wb, keys)
                             stage_s += time.perf_counter() - t_stage
                             state, losses = train_epoch(
                                 state, y, self.client_norm, keys,
@@ -1210,11 +1243,13 @@ class BlockwiseFederatedTrainer:
                                     f"epoch={nepoch} client_loss="
                                     + np.array2string(fetch(losses),
                                                       precision=4))
-                        # obs phase segments: wall-clock between host syncs.
-                        # With the single per-round sync, queued device
-                        # compute attributes to the segment containing that
-                        # sync (comm_seconds when communicating, else
-                        # sync_seconds) — see README "Observability"
+                        # obs phase segments: with obs recording, each
+                        # boundary drains the dispatch queue (_obs_sync) so
+                        # stage/train/comm measure execution; with obs off
+                        # the syncs vanish and the segments are wall-clock
+                        # between the round's single host sync — see README
+                        # "Observability" and PARITY.md
+                        self._obs_sync(obs, state, loss_acc)
                         train_s = time.perf_counter() - t_train - stage_s
                         t_comm = time.perf_counter()
                         if algo.communicates and n_comm > 0:
@@ -1265,6 +1300,7 @@ class BlockwiseFederatedTrainer:
                                     self._quarantine - 1, 0)
                         else:
                             diag = {}
+                        self._obs_sync(obs, state, z, y)
                         comm_s = time.perf_counter() - t_comm
                         t_sync = time.perf_counter()
                         # single host sync per round: the loss fetch depends on
@@ -1285,6 +1321,10 @@ class BlockwiseFederatedTrainer:
                                    comm_seconds=comm_s,
                                    sync_seconds=sync_s,
                                    **fcounts, **diag)
+                        if self._sentinel is not None:
+                            # cumulative traces-beyond-first: flat in steady
+                            # state, growing when something retraces
+                            rec["jit_retraces"] = self._sentinel.retraces
                         if cfg.update_guard and algo.communicates:
                             # quarantine census at round START (who sat this
                             # round out), next to the guard_trips the round
@@ -1365,6 +1405,8 @@ class BlockwiseFederatedTrainer:
                                         rho, self._ones_mask)
             rec = dict(epoch=epoch, loss=float(np.sum(fetch(losses))),
                        epoch_seconds=time.perf_counter() - t_epoch)
+            if self._sentinel is not None:
+                rec["jit_retraces"] = self._sentinel.retraces
             if cfg.check_results:
                 rec["accuracy"] = self.evaluate(state)
                 log(f"Epoch {epoch} acc="

@@ -1,0 +1,9 @@
+"""Fixture: triggers exactly JG101 (host sync inside a jitted fn)."""
+import jax
+
+
+def step(x):
+    return x.item()
+
+
+step_jit = jax.jit(step)

@@ -1,0 +1,11 @@
+"""Fixture: triggers exactly JG102 (Python branch on a traced value)."""
+import jax
+
+
+def select(x):
+    if x > 0:
+        return x
+    return -x
+
+
+select_jit = jax.jit(select)

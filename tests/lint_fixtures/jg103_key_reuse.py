@@ -1,0 +1,5 @@
+"""Fixture: triggers exactly JG103 (same PRNGKey built twice)."""
+import jax
+
+key_a = jax.random.PRNGKey(0)
+key_b = jax.random.PRNGKey(0)

@@ -1,0 +1,8 @@
+"""Fixture: triggers exactly JG104 (timer around dispatch, no sync)."""
+import time
+
+
+def timed_step(fn, x):
+    t0 = time.perf_counter()
+    y = fn(x)
+    return y, time.perf_counter() - t0

@@ -1,0 +1,12 @@
+"""Fixture: triggers exactly JG105 (jit closes over a concrete array)."""
+import jax
+import numpy as np
+
+
+def build(n):
+    w = np.ones(n)
+
+    def apply(x):
+        return x * w
+
+    return jax.jit(apply)

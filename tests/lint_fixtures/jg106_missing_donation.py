@@ -1,0 +1,9 @@
+"""Fixture: triggers exactly JG106 (state update without donation)."""
+import jax
+
+
+def update(state, grad):
+    return state - 0.1 * grad
+
+
+update_jit = jax.jit(update)

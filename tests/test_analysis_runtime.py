@@ -1,0 +1,187 @@
+"""Runtime sanitizers (analysis/sanitize.py): checkify wiring + retrace
+sentinel, end to end through the engines.
+
+Acceptance contract (ISSUE 4):
+
+- ``--sanitize`` FedAvg/ADMM smoke passes under checkify;
+- an injected NaN is caught (raises instead of training on garbage);
+- with sanitizer + sentinel ON the trained state is bit-identical to
+  the default path (float_checks observe, they do not rewrite math) —
+  a stronger form of the "off == pre-PR" guarantee, in the pattern of
+  test_obs.py::TestBitIdentity;
+- ``jit_retraces`` rides in the obs round records (schema v2).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flax.linen as nn
+
+from federated_pytorch_test_tpu.analysis.sanitize import (
+    TraceSentinel,
+    instrument_jit,
+    sanitize_errors,
+)
+from federated_pytorch_test_tpu.data.cifar10 import FederatedCifar10
+from federated_pytorch_test_tpu.models.base import (
+    BlockModule,
+    elu,
+    flatten,
+    max_pool_2x2,
+    pairs,
+)
+from federated_pytorch_test_tpu.obs.schema import validate_record
+from federated_pytorch_test_tpu.train import (
+    AdmmConsensus,
+    BlockwiseFederatedTrainer,
+    FedAvg,
+    FederatedConfig,
+)
+
+K = 4
+
+
+class TinyNet(BlockModule):
+    """Same toy 2-block CNN as test_obs/test_engine."""
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = max_pool_2x2(elu(nn.Conv(4, (5, 5), strides=(2, 2),
+                                     name="conv1")(x)))
+        x = flatten(x)
+        return nn.Dense(10, name="fc1")(x)
+
+    def param_order(self):
+        return pairs("conv1", "fc1")
+
+    def train_order_block_ids(self):
+        return [[0, 1], [2, 3]]
+
+    def linear_layer_ids(self):
+        return [1]
+
+
+@pytest.fixture(scope="module")
+def data():
+    return FederatedCifar10(K=K, batch=16, limit_per_client=32,
+                            limit_test=32)
+
+
+def small_cfg(**kw):
+    base = dict(K=K, Nloop=1, Nepoch=1, Nadmm=2, default_batch=16,
+                check_results=False, admm_rho0=0.1, obs_sinks="memory")
+    base.update(kw)
+    return FederatedConfig(**base)
+
+
+# ----------------------------------------------------------------------
+# unit level
+
+
+class TestSanitizeUnits:
+    def test_sanitize_errors_includes_float_checks_and_caches(self):
+        from jax.experimental import checkify
+
+        errs = sanitize_errors()
+        assert checkify.float_checks <= errs
+        assert sanitize_errors() is errs        # probed once, cached
+
+    def test_sentinel_counts_traces_and_retraces(self):
+        s = TraceSentinel()
+        f = jax.jit(s.wrap(lambda x: x * 2, "f"))
+        f(jnp.ones((2,)))
+        f(jnp.ones((2,)))                       # cached dispatch: no trace
+        assert s.counts["f"] == 1 and s.retraces == 0
+        f(jnp.ones((3,)))                       # new shape: retrace
+        assert s.counts["f"] == 2 and s.retraces == 1
+        assert s.traces == 2
+
+    def test_instrument_jit_off_is_plain_jit(self):
+        out = instrument_jit(lambda x: x + 1, "f", sanitize=False,
+                             sentinel=None)(jnp.zeros((3,)))
+        assert isinstance(out, jax.Array)       # no (err, out) wrapping
+
+    def test_instrument_jit_sanitize_catches_nan(self):
+        f = instrument_jit(lambda x: jnp.log(x), "f", sanitize=True,
+                           sentinel=None)
+        f(jnp.ones((3,)))                       # clean input passes
+        with pytest.raises(Exception, match="nan"):
+            jax.block_until_ready(f(-jnp.ones((3,))))
+
+
+# ----------------------------------------------------------------------
+# engine level
+
+
+def _run(data, algo, **cfg_kw):
+    t = BlockwiseFederatedTrainer(TinyNet(), small_cfg(**cfg_kw), data,
+                                  algo)
+    state, hist = t.run(log=lambda m: None)
+    return t, jax.device_get(state.params), hist
+
+
+class TestEngineSanitize:
+    def test_fedavg_smoke(self, data):
+        t, _, hist = _run(data, FedAvg(), sanitize=True,
+                          retrace_sentinel=True)
+        assert len(hist) > 0
+        for rec in hist:
+            assert rec["jit_retraces"] == 0     # steady-state: no retrace
+        assert t._sentinel.traces >= 1
+
+    def test_admm_smoke(self, data):
+        _, _, hist = _run(data, AdmmConsensus(), sanitize=True,
+                          retrace_sentinel=True)
+        assert len(hist) > 0 and hist[-1]["jit_retraces"] == 0
+
+    def test_round_record_with_retraces_validates(self, data):
+        _, _, hist = _run(data, AdmmConsensus(), retrace_sentinel=True)
+        rec = {"event": "round", "schema": 2, "run_id": "t" * 8,
+               "engine": "classifier", "round_index": 0,
+               "round_seconds": 0.1,
+               "jit_retraces": hist[-1]["jit_retraces"]}
+        assert validate_record(rec) is rec
+
+    def test_nan_injection_is_caught(self, data):
+        t = BlockwiseFederatedTrainer(
+            TinyNet(), small_cfg(sanitize=True), data, AdmmConsensus())
+        st = t.init_state()
+        bad = jax.tree.map(lambda x: jnp.full_like(x, jnp.nan), st.params)
+        with pytest.raises(Exception, match="nan"):
+            t.run(state=st._replace(params=bad), log=lambda m: None)
+
+    def test_sanitize_and_sentinel_are_bit_identical(self, data):
+        """The instrumented path must not perturb the math: checkify
+        float_checks observe values, the sentinel only counts traces."""
+        _, a, _ = _run(data, AdmmConsensus())
+        _, b, _ = _run(data, AdmmConsensus(), sanitize=True,
+                       retrace_sentinel=True)
+        ja, jb = jax.tree.leaves(a), jax.tree.leaves(b)
+        assert len(ja) == len(jb)
+        for x, y in zip(ja, jb):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    def test_default_records_omit_jit_retraces(self, data):
+        _, _, hist = _run(data, AdmmConsensus())
+        assert all("jit_retraces" not in rec for rec in hist)
+
+
+@pytest.mark.slow
+class TestCPCSanitize:
+    def test_cpc_round_under_checkify(self):
+        """vmap-of-checkify nesting: the LBFGS while_loop is checkified
+        per client INSIDE the vmap (checkify-of-vmap-of-while is
+        rejected by jax), batched error thrown on the host."""
+        from federated_pytorch_test_tpu.data.lofar import CPCDataSource
+        from federated_pytorch_test_tpu.train.cpc_engine import CPCTrainer
+
+        src = CPCDataSource(["a.h5", "b.h5"], ["0", "0"], batch_size=2)
+        tr = CPCTrainer(src, latent_dim=16, reduced_dim=8,
+                        lbfgs_history=3, lbfgs_max_iter=1, Niter=2,
+                        num_devices=1, sanitize=True,
+                        retrace_sentinel=True)
+        _, hist = tr.run(Nloop=1, Nadmm=1, log=lambda m: None)
+        assert len(hist) > 0
+        assert all(rec["jit_retraces"] == 0 for rec in hist)
