@@ -116,7 +116,16 @@ _STEP_FLOPS_PER_IMAGE = 3 * 2 * 0.56e9
 _PROBE = "import jax; d = jax.devices(); assert d[0].platform == 'tpu', d"
 
 
-def _acquire_backend(attempts: int = 3, probe_timeout: float = 75.0,
+def _probe_timeout() -> float:
+    """Per-probe timeout in seconds (``FEDTPU_BENCH_PROBE_TIMEOUT_S``
+    overrides; default 75).  Pod-scale relays can legitimately take longer
+    than the laptop-class default to hand out a backend, and the artifact
+    records the value used (``probe_timeout_s``) so a timeout-tuned run is
+    distinguishable from a default one."""
+    return float(os.environ.get("FEDTPU_BENCH_PROBE_TIMEOUT_S", 75.0))
+
+
+def _acquire_backend(attempts: int = 3, probe_timeout: Optional[float] = None,
                      backoff: float = 15.0) -> tuple:
     """Probe the TPU backend in a SUBPROCESS (bounded; the axon relay wedge
     hangs the first in-process device query indefinitely, so an in-process
@@ -127,14 +136,18 @@ def _acquire_backend(attempts: int = 3, probe_timeout: float = 75.0,
     count in the artifact (``relay_attempts``) so a flaky-but-eventually-
     healthy relay is visible in the perf record, not just a wedged one.
 
-    Defaults bound the worst case at ~4.3 min before the artifact falls
-    back to CPU: healthy relay probes connect in ~10-30s, and the caller's
-    own timeout must not expire before the one-line artifact is emitted.
+    Defaults bound the worst case at ~4.5 min before the artifact falls
+    back to CPU (3 x 75s probes + 15s, 30s exponential backoff): healthy
+    relay probes connect in ~10-30s, and the caller's own timeout must not
+    expire before the one-line artifact is emitted.  ``probe_timeout``
+    defaults from ``FEDTPU_BENCH_PROBE_TIMEOUT_S`` (_probe_timeout).
 
     Must run before this process's first DEVICE QUERY: the fallback pins
     the platform via ``jax.config.update``, which only takes effect if it
     lands before backend initialization (importing jax earlier is fine).
     """
+    if probe_timeout is None:
+        probe_timeout = _probe_timeout()
     used = 0
     if os.environ.get("FEDTPU_BENCH_FORCE_CPU") == "1":
         err = "TPU skipped: FEDTPU_BENCH_FORCE_CPU=1"
@@ -142,7 +155,9 @@ def _acquire_backend(attempts: int = 3, probe_timeout: float = 75.0,
         last = None
         for attempt in range(attempts):
             if attempt:
-                time.sleep(backoff)
+                # exponential: a relay mid-restart needs tens of seconds,
+                # not another immediate poke — backoff, 2x backoff, ...
+                time.sleep(backoff * 2 ** (attempt - 1))
             used = attempt + 1
             try:
                 r = subprocess.run(
@@ -312,7 +327,12 @@ def _bench_round(trainer, ci, *, reps, with_comm=False, with_staging=False,
     x0 = jax.device_put(jnp.zeros((K, 1), jnp.float32), csh)
     yhat0 = jax.device_put(jnp.zeros((K, 1), jnp.float32), csh)
 
-    def round_(state, z, y, rho):
+    # every loop-carried array is threaded THROUGH round_ and rebound,
+    # x0/yhat0 included: with --donate the comm fn donates all six block
+    # vars, so reusing a stale captured buffer on the next rep would hit
+    # a deleted-array error (and silently measure nothing on backends
+    # that tolerate it)
+    def round_(state, z, y, rho, x0, yhat0):
         if with_staging:
             bx, by, bw = trainer._stage_epoch()
             ks = trainer._epoch_keys()
@@ -323,10 +343,10 @@ def _bench_round(trainer, ci, *, reps, with_comm=False, with_staging=False,
                                     trainer._ones_mask)
         diag = None
         if with_comm:
-            state, z, y, rho, _, _, diag = comm_fns["plain"](
+            state, z, y, rho, x0, yhat0, diag = comm_fns["plain"](
                 state, z, y, rho, x0, yhat0, trainer._ones_mask,
                 trainer._zero_corrupt, trainer._inf_bound)
-        return state, z, y, rho, losses, diag
+        return state, z, y, rho, x0, yhat0, losses, diag
 
     def sync(losses, diag):
         # NOTE: under the axon relay block_until_ready does not
@@ -337,20 +357,24 @@ def _bench_round(trainer, ci, *, reps, with_comm=False, with_staging=False,
             jax.tree.map(np.asarray, diag)
 
     # warm-up / compile
-    state, z, y, rho, losses, diag = round_(state, z, y, rho)
-    sync(losses, diag)
+    carry = round_(state, z, y, rho, x0, yhat0)
+    sync(*carry[6:])
 
     t0 = time.perf_counter()
     for _ in range(reps):
-        state, z, y, rho, losses, diag = round_(state, z, y, rho)
-    sync(losses, diag)
+        carry = round_(*carry[:6])
+    sync(*carry[6:])
     dt = time.perf_counter() - t0
 
     from federated_pytorch_test_tpu.obs.report import record_ips
 
     fields = dict(label=label or f"block_{ci}", N=int(N), K=int(K),
                   round_seconds=dt, images=reps * images_per_epoch,
-                  nadmm=reps)
+                  nadmm=reps,
+                  # jitted dispatches the host issued inside the timed
+                  # region: one epoch (+ one comm) per rep — the fused
+                  # engine path collapses the same work to 1/round
+                  host_dispatches=reps * (2 if with_comm else 1))
     if trainer._sentinel is not None:
         # cumulative across the trainer: any growth between sections
         # means a timed region recompiled mid-measurement
@@ -795,6 +819,7 @@ def main():
         "measured": False,
     }
     # probe BEFORE importing jax (the wedge hangs in-process init)
+    out["probe_timeout_s"] = _probe_timeout()
     err, out["relay_attempts"] = _acquire_backend()
     if err is not None:
         out["error"] = err

@@ -706,8 +706,14 @@ class RecompileHazard(Rule):
 # ------------------------------------------------------------------- JG106
 
 class MissingDonation(Rule):
+    """Warning severity: with the engine donation-safe end to end (every
+    state-carrying jit site either donates or carries an explicit
+    suppression explaining why the caller must keep the input alive), an
+    undeclared site is a real perf bug — the round allocates a second copy
+    of the model state on TPU — not a style nit."""
+
     id = "JG106"
-    severity = Severity.ADVICE
+    severity = Severity.WARNING
     summary = "jitted update fn carries large state but donates no buffers"
 
     def check(self, module: ModuleContext) -> Iterator[Finding]:
@@ -723,9 +729,9 @@ class MissingDonation(Rule):
             yield self.finding(
                 module, site.node,
                 f"jit of {fn_name!r} updates large state "
-                f"({', '.join(hit)}) without donate_argnums; donating "
-                "would reuse the input buffers in-place on TPU "
-                "(advisory — verify no caller reuses the donated arrays)")
+                f"({', '.join(hit)}) without donate_argnums; donate (or "
+                "spell donate_argnums=() / suppress with a why-comment "
+                "when the caller must keep the input buffers alive)")
 
 
 ALL_RULES: Sequence[Rule] = (

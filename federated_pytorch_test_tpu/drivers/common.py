@@ -33,7 +33,7 @@ def build_parser(defaults: FederatedConfig, prog: str) -> argparse.ArgumentParse
     _optional_types = {"data_dir": str, "num_devices": int,
                        "profile_dir": str, "obs_dir": str}
     # tri-state booleans: absent -> None (auto), --flag/--no-flag override
-    _optional_bools = {"device_data"}
+    _optional_bools = {"device_data", "donate"}
     for f in dataclasses.fields(FederatedConfig):
         default = getattr(defaults, f.name)
         arg = "--" + f.name.replace("_", "-")

@@ -59,6 +59,16 @@ def main(argv=None):
                    action=argparse.BooleanOptionalAction, default=False,
                    help="save a resumable checkpoint every comm round; "
                         "resume with --load-model")
+    p.add_argument("--async-checkpoint",
+                   action=argparse.BooleanOptionalAction, default=False,
+                   help="write mid-run checkpoints from a background "
+                        "thread (host snapshot first, so it is donation-"
+                        "safe); same on-disk slot format")
+    p.add_argument("--donate", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="donate the round fn's state/z/opt buffers to XLA "
+                        "(default: auto — on for TPU/GPU, off on CPU); "
+                        "bit-identical either way")
     p.add_argument("--sanitize", action=argparse.BooleanOptionalAction,
                    default=False,
                    help="run the jitted CPC round under "
@@ -87,7 +97,8 @@ def main(argv=None):
     trainer = CPCTrainer(data, latent_dim=args.Lc, reduced_dim=args.Rc,
                          Niter=args.Niter, num_devices=args.num_devices,
                          sanitize=args.sanitize,
-                         retrace_sentinel=args.retrace_sentinel)
+                         retrace_sentinel=args.retrace_sentinel,
+                         donate=args.donate)
     print(f"federated_cpc: K={data.K} Lc={args.Lc} Rc={args.Rc} "
           f"devices={trainer.D}")
     state = trainer.state0
@@ -114,6 +125,7 @@ def main(argv=None):
                                  state=state, profile_dir=args.profile_dir,
                                  checkpoint_path=midrun,
                                  resume=args.load_model and midrun is not None,
+                                 async_checkpoint=args.async_checkpoint,
                                  obs_dir=obs_dir, obs_sinks=args.obs_sinks,
                                  obs_run_name="federated_cpc")
     print("Finished Training")

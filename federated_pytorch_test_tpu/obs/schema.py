@@ -26,8 +26,14 @@ from typing import Any, Dict
 # v2 (additive): optional per-round `jit_retraces` — cumulative jit
 # retrace count from the engine's retrace sentinel
 # (analysis/sanitize.py), present when --retrace-sentinel is on.
-# v1 records remain valid: validate_record accepts ver <= SCHEMA_VERSION.
-SCHEMA_VERSION = 2
+# v3 (additive): optional per-round `host_dispatches` — how many jitted
+# step dispatches the host issued for the round (fused rounds: exactly 1
+# for the train+comm phase vs Nepoch+1 unfused) — and `ckpt_write_seconds`
+# — wall-clock the round spent in the mid-run save call (async
+# checkpointing: snapshot+enqueue only, so near zero unless the writer's
+# backpressure barrier engaged).
+# v1/v2 records remain valid: validate_record accepts ver <= SCHEMA_VERSION.
+SCHEMA_VERSION = 3
 
 EVENTS = ("run_header", "round", "summary")
 
@@ -96,6 +102,8 @@ FIELDS: Dict[str, Any] = {
     "epoch_seconds": (("round",), _NUM),
     # recompilation sentinel (schema v2; --retrace-sentinel)
     "jit_retraces": (("round",), _INT),
+    "host_dispatches": (("round",), _INT),
+    "ckpt_write_seconds": (("round",), _NUM),
     # communication volume
     "bytes_on_wire": (("round",), _INT),
     "bytes_dense":  (("round",), _INT),

@@ -10,6 +10,12 @@ that axis followed by a ``lax.psum`` across the mesh.
 Exchanging only the masked flat block vector (see utils/codec.py) keeps the
 communicated bytes proportional to the active block — the reference's core
 bandwidth-reduction claim (README.md:2).
+
+These helpers are pure functions of their operands (no aliasing, no
+captured arrays), which is what lets the engine donate the buffers feeding
+them: under ``--fused-rounds`` the same bodies run inside the one fused
+round dispatch (train/engine.py ``_build_fused``) with the client state
+and block vars donated, and XLA is free to reuse the input HBM in place.
 """
 
 from __future__ import annotations

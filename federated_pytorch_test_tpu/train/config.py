@@ -136,6 +136,29 @@ class FederatedConfig:
     # disappears from the steady state.  None = auto: on when the training
     # set fits the HBM budget (FEDTPU_DEVICE_DATA_MB, default 2048).
     device_data: Optional[bool] = None
+    # stage epoch n+1's batches while epoch n computes (device_data off:
+    # overlaps the host shuffle + H2D copy with device work).  On by
+    # default — --no-prefetch isolates the staging overhead when profiling.
+    prefetch: bool = True
+
+    # fused round execution: when epoch data is device-resident
+    # (device_data), collapse the Nepoch-epoch host loop AND the
+    # communication update into ONE jitted dispatch per round — epoch PRNG
+    # keys are derived on-device from the same counter-keyed seeds the
+    # host staging path uses, so the math (and resume determinism) is
+    # bit-identical to the unfused path.  Requested-but-unusable (no
+    # device data / be_verbose) falls back to the per-epoch loop with a
+    # warning.  Off by default (dense CPU tier-1 path unchanged).
+    fused_rounds: bool = False
+
+    # buffer donation: pass donate_argnums for the client state and the
+    # consensus block vars (z/y/rho/x0/yhat0) on the train/comm/fused
+    # round fns so XLA reuses their device buffers in place of fresh
+    # allocations.  None = auto: on for TPU/GPU backends, off on CPU
+    # (honored there too, but the tests' reference semantics keep inputs
+    # alive by default).  Purely an allocator hint — outputs are
+    # bit-identical either way.
+    donate: Optional[bool] = None
 
     # checkpointing
     checkpoint_dir: str = "./checkpoints"
@@ -144,6 +167,15 @@ class FederatedConfig:
     # with --load-model.  Beyond the reference, which only restarts from its
     # end-of-run s<k>.model files (federated_multi.py:99-103, :226-233)
     midrun_checkpoint: bool = False
+    # async mid-run checkpointing: _save_midrun snapshots device state to
+    # host without blocking (the D2H copy starts immediately and is
+    # materialized before the next round dispatch — donation-safe) and a
+    # background writer thread handles serialize + sha256 + slot rotation,
+    # with a write barrier on rotation and on run exit.  The on-disk
+    # format, slot protocol and corrupt-slot fallback are unchanged; only
+    # WHEN the bytes hit disk moves off the round's critical path.
+    # Multi-host runs fall back to the synchronous collective save.
+    async_checkpoint: bool = False
 
     # mesh: None -> use as many devices as divide K
     num_devices: Optional[int] = None

@@ -74,10 +74,21 @@ class CPCTrainer:
                  reduced_dim: int = 32, lbfgs_history: int = 7,
                  lbfgs_max_iter: int = 2, Niter: int = 10,
                  init_seed: int = 0, num_devices: Optional[int] = None,
-                 sanitize: bool = False, retrace_sentinel: bool = False):
+                 sanitize: bool = False, retrace_sentinel: bool = False,
+                 donate: Optional[bool] = None):
         self.data = data
         self.K = data.K
         self.Niter = Niter
+        # buffer donation (classifier-engine parity; None = auto: on for
+        # accelerator backends): the jitted round donates state/z/
+        # opt_state — all rebound from its outputs — so XLA reuses the
+        # buffers in place.  _run_impl deep-copies the entry state so
+        # state0 (read by every later _build_round) is never donated away.
+        self._donate = (donate if donate is not None
+                        else jax.default_backend() != "cpu")
+        # async checkpoint writer (utils/checkpoint.py), created by
+        # _run_impl when async_checkpoint and a checkpoint path exist
+        self._ckpt_writer = None
         # observability (obs/): last RunRecorder opened by run()
         self.obs_recorder = None
         # runtime sanitizers (analysis/sanitize.py, classifier-engine
@@ -285,11 +296,17 @@ class CPCTrainer:
         inner = shard_map(round_shard, mesh=self.mesh,
                           in_specs=(state_spec, spec_r, spec_c, spec_c),
                           out_specs=out_specs, check_vma=False)
+        # donate state/z/opt_state (argnums 0-2): the round loop rebinds
+        # all three from the outputs; the staged data (argnum 3) is fresh
+        # every round and left alone
         fn = instrument_jit(inner, f"round[{mdl},blk={ci},{px}x{py}]",
-                            sanitize=False, sentinel=self._sentinel)
+                            sanitize=False, sentinel=self._sentinel,
+                            donate_argnums=((0, 1, 2) if self._donate
+                                            else ()))
         if self.sanitize:
             fn = throwing(fn)
-        init_fn = jax.jit(
+        # no donation: init reads the state the caller keeps training with
+        init_fn = jax.jit(  # graftlint: disable=JG106
             shard_map(init_opt, mesh=self.mesh, in_specs=(state_spec,),
                       out_specs=spec_c, check_vma=False))
         self._fn_cache[key] = (fn, init_fn, N)
@@ -306,6 +323,7 @@ class CPCTrainer:
         from federated_pytorch_test_tpu.utils.checkpoint import (
             pack_history,
             save_checkpoint_swapped,
+            snapshot_to_host,
         )
 
         nloop, mdl_i, ci, nadmm = nxt
@@ -328,7 +346,20 @@ class CPCTrainer:
             "data_round": len(history),
             "history": pack_history(history),
         }
-        save_checkpoint_swapped(path, tree, meta)
+        if self._ckpt_writer is not None:
+            # async: materialize a host copy first (donation-safe — the
+            # device buffers may be reused by the next round's dispatch),
+            # then let the writer thread serialize + hash + rotate slots
+            self._ckpt_writer.submit(path, snapshot_to_host(tree), meta)
+        else:
+            save_checkpoint_swapped(path, tree, meta)
+
+    def _flush_ckpt_writer(self) -> None:
+        """Barrier + teardown for the async checkpoint writer (no-op when
+        checkpointing is synchronous); re-raises any background failure."""
+        writer, self._ckpt_writer = self._ckpt_writer, None
+        if writer is not None:
+            writer.close()
 
     def _restore_midrun(self, path):
         from federated_pytorch_test_tpu.utils.checkpoint import (
@@ -365,6 +396,7 @@ class CPCTrainer:
             log: Callable[[str], None] = print, prefetch: bool = True,
             profile_dir: Optional[str] = None,
             checkpoint_path: Optional[str] = None, resume: bool = False,
+            async_checkpoint: bool = False,
             obs_dir: Optional[str] = None, obs_sinks: str = "auto",
             obs_run_name: str = "cpc_admm"):
         """The rotation loop (federated_cpc.py:194-304).
@@ -398,16 +430,23 @@ class CPCTrainer:
         comm round + summary; same contract as the classifier engine —
         "auto" with no ``obs_dir`` is a no-op, so bare API calls stay
         file-free).  The last recorder is kept on ``self.obs_recorder``.
+
+        ``async_checkpoint`` moves the mid-run save's serialize + sha256 +
+        slot rotation to a background writer thread (the device state is
+        snapshotted to host first, so it composes with donation); the
+        on-disk slot protocol and corrupt-slot fallback are unchanged.
         """
         with profile_ctx(profile_dir):
             return self._run_impl(Nloop, Nadmm, state, log, prefetch,
                                   checkpoint_path, resume,
+                                  async_checkpoint=async_checkpoint,
                                   profile_on=profile_dir is not None,
                                   obs_dir=obs_dir, obs_sinks=obs_sinks,
                                   obs_run_name=obs_run_name)
 
     def _run_impl(self, Nloop, Nadmm, state, log, prefetch,
-                  checkpoint_path=None, resume=False, profile_on=False,
+                  checkpoint_path=None, resume=False, async_checkpoint=False,
+                  profile_on=False,
                   obs_dir=None, obs_sinks="auto", obs_run_name="cpc_admm"):
         from federated_pytorch_test_tpu.utils.checkpoint import (
             CheckpointCorruptError,
@@ -416,6 +455,11 @@ class CPCTrainer:
         )
 
         state = state or self.state0
+        if self._donate:
+            # the round fns donate their state argument; state0 (or the
+            # caller's array) must survive the run — _build_round reads
+            # state0 for mask/size templates all run long
+            state = jax.tree.map(jnp.copy, state)
         history: List[Dict[str, Any]] = []
         csh = client_sharding(self.mesh)
         rows = local_client_rows(self.mesh, self.K)
@@ -466,6 +510,18 @@ class CPCTrainer:
         if restored and n_rounds == 0:
             log("resumed a COMPLETED run: no rounds remain at "
                 f"Nloop={Nloop} Nadmm={Nadmm}; returning the saved history")
+        if async_checkpoint and checkpoint_path is not None:
+            from federated_pytorch_test_tpu.utils.checkpoint import (
+                AsyncCheckpointWriter,
+            )
+            if jax.process_count() > 1:
+                import warnings
+                warnings.warn(
+                    "async_checkpoint is single-process only (the slot "
+                    "swap must be collective across hosts); falling back "
+                    "to synchronous checkpointing")
+            else:
+                self._ckpt_writer = AsyncCheckpointWriter()
         obs = make_recorder(obs_sinks, obs_dir, run_name=obs_run_name,
                             engine="cpc", algorithm="fedavg")
         obs.open(config={"Nloop": Nloop, "Nadmm": Nadmm,
@@ -516,6 +572,9 @@ class CPCTrainer:
                                     state, z, opt_state, staged)
                                 rec = dict(nloop=nloop, model=mdl, block=ci,
                                            nadmm=nadmm, N=N,
+                                           # the whole round is one jitted
+                                           # dispatch by construction here
+                                           host_dispatches=1,
                                            dual_residual=float(dual),
                                            loss=float(np.sum(fetch(losses))),
                                            # dense f32 block payload from all
@@ -533,11 +592,6 @@ class CPCTrainer:
                                     rec["jit_retraces"] = \
                                         self._sentinel.retraces
                                 history.append(rec)
-                                if obs.enabled:
-                                    obs.round(dict(
-                                        rec, round_index=len(history) - 1,
-                                        bytes_dense=4 * N * self.K,
-                                        **device_memory_stats()))
                                 if checkpoint_path is not None:
                                     if nadmm + 1 < Nadmm:
                                         nxt = (nloop, mdl_i, ci, nadmm + 1)
@@ -547,18 +601,38 @@ class CPCTrainer:
                                         nxt = (nloop, mdl_i + 1, 0, 0)
                                     else:
                                         nxt = (nloop + 1, 0, 0, 0)
+                                    # timed so async-vs-sync shows up in the
+                                    # record: async = snapshot + enqueue
+                                    # only; the sync save's np.asarray is
+                                    # its own device sync, so no explicit
+                                    # block is wanted in this region
+                                    t_ckpt = time.perf_counter()  # graftlint: disable=JG104
                                     self._save_midrun(checkpoint_path, state, z,
                                                       opt_state, px, py, nxt,
                                                       history)
+                                    rec["ckpt_write_seconds"] = (
+                                        time.perf_counter() - t_ckpt)
+                                if obs.enabled:
+                                    obs.round(dict(
+                                        rec, round_index=len(history) - 1,
+                                        bytes_dense=4 * N * self.K,
+                                        **device_memory_stats()))
                                 log(f"dual (N={N},loop={nloop},model={mdl},"
                                     f"block={ci},avg={nadmm})="
                                     f"{rec['dual_residual']:e} "
                                     f"loss={rec['loss']:e}")
         except BaseException:
+            try:                     # abort path: the original error wins
+                self._flush_ckpt_writer()
+            except Exception:
+                pass
             obs.close(status="aborted")
             raise
         finally:
             if src is not None:
                 src.close()
         obs.close()
+        # write barrier: any queued async save must be durable (and any
+        # background failure raised) before the run reports success
+        self._flush_ckpt_writer()
         return state, history

@@ -234,8 +234,23 @@ class BlockwiseFederatedTrainer:
         # round (_stage_epoch), and mid-run resume only needs the counter
         self._epochs_staged = 0
         self._keys_staged = 0
-        self._prefetch_epochs = True
+        self._prefetch_epochs = bool(cfg.prefetch)
         self._pending: Optional[tuple] = None
+        # buffer donation (cfg.donate; None = auto: accelerators only —
+        # CPU honors donation too, but keeping the caller-side arrays
+        # alive is the safer default where nobody is memory-bound):
+        # the train/comm/fused step jits donate the client state and the
+        # consensus block vars, every one of which the round loop rebinds
+        # from the step's outputs before the next dispatch
+        self._donate = (cfg.donate if cfg.donate is not None
+                        else jax.default_backend() != "cpu")
+        # train-phase host dispatches (cumulative): the unfused loop costs
+        # Nepoch per comm round, the fused executor exactly 1 — the obs
+        # per-round delta is the tracked metric (`host_dispatches`)
+        self._host_dispatches = 0
+        # async checkpoint writer (utils/checkpoint.py), created by
+        # _run_impl when cfg.async_checkpoint and a checkpoint path exist
+        self._ckpt_writer = None
         import concurrent.futures
         self._stage_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="epoch-stage")
@@ -273,6 +288,20 @@ class BlockwiseFederatedTrainer:
         self._dev_gather = None
         if self._want_device_data():
             self._setup_device_data()
+        # fused round execution (cfg.fused_rounds): needs the epoch data
+        # device-resident (the whole round must be traceable) and is
+        # pointless under be_verbose (per-epoch host prints force the
+        # Nepoch dispatch pattern back anyway)
+        self._use_fused = bool(cfg.fused_rounds)
+        if self._use_fused and (self._dev_gather is None or cfg.be_verbose):
+            import warnings
+            why = ("be_verbose syncs the host every epoch"
+                   if cfg.be_verbose else
+                   "epoch data is not device-resident (device_data)")
+            warnings.warn(
+                f"fused_rounds requested but unusable: {why}; "
+                "falling back to the per-epoch round loop", stacklevel=2)
+            self._use_fused = False
 
     # ------------------------------------------------------------------
     # masks / per-block plumbing (hooks overridable by workload subclasses)
@@ -345,13 +374,21 @@ class BlockwiseFederatedTrainer:
     # ------------------------------------------------------------------
     # compiled steps (built per block; cached)
     # ------------------------------------------------------------------
-    def _instrument_jit(self, fn, name: str):
+    def _instrument_jit(self, fn, name: str, **jit_kwargs):
         """jit ``fn`` with the config's sanitize/retrace instrumentation
         (analysis/sanitize.py).  With both knobs off — the default —
-        this is exactly ``jax.jit(fn)``: the dense path stays
-        bit-identical by construction."""
+        this is exactly ``jax.jit(fn, **jit_kwargs)``: the dense path
+        stays bit-identical by construction."""
         return instrument_jit(fn, name, sanitize=self.cfg.sanitize,
-                              sentinel=self._sentinel)
+                              sentinel=self._sentinel, **jit_kwargs)
+
+    def _donate_argnums(self, argnums) -> tuple:
+        """donate_argnums for a step jit: the real tuple when donation is
+        on, else ``()`` — identical to not donating (jax treats an empty
+        tuple exactly like an absent kwarg), but the kwarg is always
+        spelled at the call site so the donation contract is visible
+        (graftcheck JG106)."""
+        return tuple(argnums) if self._donate else ()
 
     def _build_fns(self, ci: Optional[int]):
         """(train_epoch, comm_round, init_opt) specialised to block ``ci``."""
@@ -578,6 +615,12 @@ class BlockwiseFederatedTrainer:
         spec_r = P()
         state_specs = ClientState(spec_c, spec_c, spec_c, spec_c)
 
+        # donation (cfg.donate): the state is argnum 0 everywhere; the
+        # comm/fused steps additionally own the block vars z/y/rho/x0/
+        # yhat0 (argnums 1-5) — every donated input is rebound from the
+        # step's outputs by the round loop before the next dispatch.
+        # Replicated per-round inputs (masks, norm stats, staged data,
+        # guard bound) are NEVER donated: they are reused across rounds.
         train_epoch = self._instrument_jit(
             shard_map(
                 epoch_shard,
@@ -587,7 +630,8 @@ class BlockwiseFederatedTrainer:
                 out_specs=(state_specs, spec_c),
                 check_vma=False,
             ),
-            f"train_epoch[blk={ci}]")
+            f"train_epoch[blk={ci}]",
+            donate_argnums=self._donate_argnums((0,)))
 
         comm_out = (state_specs, spec_r, spec_c, spec_r, spec_c,
                     spec_c, spec_r)
@@ -604,7 +648,8 @@ class BlockwiseFederatedTrainer:
                     out_specs=comm_out,
                     check_vma=False,
                 ),
-                f"comm[{mode},blk={ci}]")
+                f"comm[{mode},blk={ci}]",
+                donate_argnums=self._donate_argnums((0, 1, 2, 3, 4, 5)))
 
         def init_opt(params):
             if use_lbfgs:
@@ -613,14 +658,134 @@ class BlockwiseFederatedTrainer:
                         codec.get_trainable_values(p, order, mask))
                 )(params)
             return jax.vmap(tx.init)(params)
-        init_opt = jax.jit(
+        # no donation: callers keep ``params`` (the state that carries it
+        # is re-assembled around the fresh opt state) — see JG106 note
+        init_opt = jax.jit(  # graftlint: disable=JG106
             shard_map(init_opt, mesh=self.mesh, in_specs=(spec_c,),
                       out_specs=spec_c, check_vma=False)
         )
 
+        # raw shard bodies for the fused executor (_build_fused): the
+        # fused round re-traces them inside its own shard_map context
+        self._fn_cache[("shard_bodies", ci)] = (epoch_shard, comm_shard)
         fns = (train_epoch, comm_fns, init_opt)
         self._fn_cache[key] = fns
         return fns
+
+    def _comm_mode(self, nadmm: int) -> str:
+        """Which comm variant this round runs (consensus_multi.py:242-278):
+        BB stores the round-0 snapshot, refreshes rho every bb_period_T
+        rounds, and otherwise runs the plain consensus update."""
+        cfg = self.cfg
+        if cfg.bb_update and nadmm == 0:
+            return "bb_store"
+        if cfg.bb_update and nadmm > 0 and nadmm % cfg.bb_period_T == 0:
+            return "bb"
+        return "plain"
+
+    def _build_fused(self, ci: Optional[int]):
+        """Fused round executor for block ``ci`` (cfg.fused_rounds).
+
+        One jitted dispatch runs the whole communication round:
+        ``lax.scan`` over the Nepoch local epochs — each epoch's shuffle
+        permutation AND reparam keys are derived ON DEVICE from the same
+        counter-keyed seeds the host staging path uses (`_epoch_seed`),
+        via the identical ``key_data(split(PRNGKey(seed), K))``
+        construction, so the math is bit-identical to the unfused path —
+        with the comm update (`plain`/`bb_store`/`bb`, static) fused
+        behind the scan.  Requires device-resident epoch data
+        (``_setup_device_data``): the raw shards enter as non-donated
+        operands and the per-epoch gather happens inside the trace.
+        """
+        key = ("fused", ci)
+        if key in self._fn_cache:
+            return self._fn_cache[key]
+        assert self._dev_gather is not None, \
+            "fused rounds need device-resident epoch data"
+        self._build_fns(ci)            # populates the shard bodies
+        epoch_shard, comm_shard = self._fn_cache[("shard_bodies", ci)]
+        cfg = self.cfg
+        K, K_local = cfg.K, self.K_local
+        steps, B = self.data.steps, self.data.batch
+        n = self.data.samples_per_client
+        nB = steps * B
+        guard_on = cfg.update_guard
+
+        def local_keys(seed):
+            # EXACTLY the host staging construction (_stage_epoch /
+            # _epoch_keys): key_data(split(PRNGKey(seed), K)) -> [K, 2]
+            # u32, then this device's contiguous client block.  The raw
+            # u32 rows are legacy keys, as on the host path.
+            kd = jax.random.key_data(
+                jax.random.split(jax.random.PRNGKey(seed), K))
+            d = lax.axis_index(CLIENT_AXIS)
+            return lax.dynamic_slice_in_dim(kd, d * K_local, K_local)
+
+        def gather_one(key, x, y):
+            # mirror of _setup_device_data's per-client epoch gather
+            perm = jax.random.permutation(key, n)
+            if nB > n:
+                perm = jnp.concatenate([perm, perm[: nB - n]])
+            idx = perm[:nB]
+            return (x[idx].reshape(steps, B, *x.shape[1:]),
+                    y[idx].reshape(steps, B))
+
+        def fused_shard(state: ClientState, z, y, rho, x0, yhat0, active,
+                        comm_active, corrupt, gbound, seeds, norm, xs, ys,
+                        wb, mode):
+            def epoch(carry, seed_pair):
+                st, loss_acc = carry
+                xb, yb = jax.vmap(gather_one, in_axes=(0, 0, 0))(
+                    local_keys(seed_pair[0]), xs, ys)
+                st, losses = epoch_shard(st, y, norm, local_keys(seed_pair[1]),
+                                         xb, yb, wb, z, rho, active)
+                return (st, loss_acc + losses), None
+
+            (state, loss_acc), _ = lax.scan(
+                epoch, (state, jnp.zeros((K_local,), jnp.float32)), seeds)
+            out = comm_shard(state, z, y, rho, x0, yhat0, comm_active,
+                             corrupt, gbound, mode=mode)
+            return out + (loss_acc,)
+
+        spec_c = P(CLIENT_AXIS)
+        spec_r = P()
+        state_specs = ClientState(spec_c, spec_c, spec_c, spec_c)
+        comm_out = (state_specs, spec_r, spec_c, spec_r, spec_c,
+                    spec_c, spec_r)
+        if guard_on:
+            comm_out = comm_out + (spec_c,)
+        fused_fns = {}
+        for mode in ("plain", "bb_store", "bb"):
+            fused_fns[mode] = self._instrument_jit(
+                shard_map(
+                    functools.partial(fused_shard, mode=mode),
+                    mesh=self.mesh,
+                    in_specs=(state_specs, spec_r, spec_c, spec_r, spec_c,
+                              spec_c, spec_c, spec_c, spec_c, spec_r,
+                              spec_r, spec_c, spec_c, spec_c, spec_c),
+                    out_specs=comm_out + (spec_c,),
+                    check_vma=False,
+                ),
+                f"fused_round[{mode},blk={ci}]",
+                donate_argnums=self._donate_argnums((0, 1, 2, 3, 4, 5)))
+        self._fn_cache[key] = fused_fns
+        return fused_fns
+
+    def _fused_epoch_seeds(self):
+        """Stage this round's [Nepoch, 2] int32 epoch seeds (column 0:
+        data shuffle stream, column 1: reparam-key stream) and advance
+        BOTH counters by Nepoch — exactly the bookkeeping the unfused
+        loop's Nepoch (_stage_epoch + _epoch_keys) calls perform, so a
+        checkpoint taken after a fused round resumes identically on
+        either path."""
+        c0, c1 = self._epochs_staged, self._keys_staged
+        Nepoch = self.cfg.Nepoch
+        seeds = np.asarray(
+            [[self._epoch_seed(c0 + e, 0), self._epoch_seed(c1 + e, 1)]
+             for e in range(Nepoch)], np.int32)
+        self._epochs_staged += Nepoch
+        self._keys_staged += Nepoch
+        return stage_global(seeds, replicated_sharding(self.mesh))
 
     def _build_gather(self, ci: Optional[int]):
         """[K, N] stack of flat active-block vectors (cached per block)."""
@@ -675,7 +840,9 @@ class BlockwiseFederatedTrainer:
             )
 
         spec_c = P(CLIENT_AXIS)
-        fn = jax.jit(
+        # no donation: evaluation is a read — the caller's state (and the
+        # round loop behind it) keeps using params/batch_stats
+        fn = jax.jit(  # graftlint: disable=JG106
             shard_map(
                 eval_shard,
                 mesh=self.mesh,
@@ -803,6 +970,25 @@ class BlockwiseFederatedTrainer:
             np.asarray(self.cfg.guard_norm_mult * self._guard_scale,
                        np.float32), replicated_sharding(self.mesh))
 
+    def _apply_guard_verdicts(self, diag, okf, comm_host) -> None:
+        """Host-side guard aftermath, shared by the fused and unfused
+        round paths: quarantine this round's offenders (active AND
+        rejected — okf alone cannot tell a rejected client from one that
+        never participated), tick running sentences down one round, and
+        fold the accepted delta-norm scale into the guard bound (EMA;
+        the first clean round seeds it)."""
+        cfg = self.cfg
+        okf_h = np.asarray(fetch(okf))
+        tripped = (comm_host > 0) & (okf_h < 0.5)
+        self._quarantine = np.maximum(self._quarantine - 1, 0)
+        if cfg.quarantine_rounds > 0:
+            self._quarantine[tripped] = cfg.quarantine_rounds
+        if diag.get("n_ok", 0.0) > 0:
+            nm = diag["guard_norm_mean"]
+            self._guard_scale = (
+                nm if not np.isfinite(self._guard_scale)
+                else 0.5 * self._guard_scale + 0.5 * nm)
+
     def _want_device_data(self) -> bool:
         want = self.cfg.device_data
         if want is False:
@@ -893,7 +1079,12 @@ class BlockwiseFederatedTrainer:
         return stage_global(keys, client_sharding(self.mesh))
 
     def init_state(self) -> ClientState:
-        return ClientState(self.params0, self.batch_stats0, None)
+        """A fresh training state — a deep COPY of the staged init, never
+        an alias: the round fns donate the state's buffers (``--donate``),
+        and ``params0``/``batch_stats0`` must survive them (``block_size``
+        and the mask builders read ``params0`` all run long)."""
+        copy = lambda t: jax.tree.map(jnp.copy, t)
+        return ClientState(copy(self.params0), copy(self.batch_stats0), None)
 
     def _init_comp_state(self, ci: Optional[int]):
         """Fresh [K]-stacked compressor state for block ``ci`` (or None).
@@ -933,6 +1124,7 @@ class BlockwiseFederatedTrainer:
         from federated_pytorch_test_tpu.utils.checkpoint import (
             pack_history,
             save_checkpoint_swapped,
+            snapshot_to_host,
         )
 
         nloop, ci, nadmm = nxt
@@ -963,7 +1155,15 @@ class BlockwiseFederatedTrainer:
             # run would readmit an offender early / drop the bound
             meta["quarantine"] = np.asarray(self._quarantine, np.int64)
             meta["guard_scale"] = np.asarray(self._guard_scale, np.float64)
-        save_checkpoint_swapped(path, tree, meta)
+        if self._ckpt_writer is not None:
+            # async path: materialize a host copy NOW (donation-safe — the
+            # device buffers may be donated away by the very next round's
+            # dispatch) and let the writer thread serialize/sha256/rotate;
+            # the submission queue orders saves, so slot rotation for
+            # round N always completes before round N+1 touches the dir
+            self._ckpt_writer.submit(path, snapshot_to_host(tree), meta)
+        else:
+            save_checkpoint_swapped(path, tree, meta)
 
     def _restore_midrun(self, path):
         from federated_pytorch_test_tpu.utils.checkpoint import (
@@ -1087,6 +1287,22 @@ class BlockwiseFederatedTrainer:
         self._prefetch_epochs = False     # no further submits
         self._pending = None
         self._stage_pool.shutdown(wait=False, cancel_futures=True)
+        # drain the async checkpoint writer so an aborted run's LAST
+        # submitted round is still durable on disk (the kill/resume
+        # contract); a background write failure must not mask the
+        # exception that aborted the run, so it is swallowed here —
+        # the normal-exit barrier in _run_impl re-raises instead
+        try:
+            self._flush_ckpt_writer()
+        except Exception:
+            pass
+
+    def _flush_ckpt_writer(self) -> None:
+        """Write barrier: wait for queued async checkpoint saves, then
+        retire the writer (idempotent; re-raises background failures)."""
+        writer, self._ckpt_writer = self._ckpt_writer, None
+        if writer is not None:
+            writer.close()
 
     def __del__(self):
         try:
@@ -1162,6 +1378,20 @@ class BlockwiseFederatedTrainer:
                     "no valid mid-run checkpoint slot survives: "
                     + "; ".join(failures))
 
+        if cfg.async_checkpoint and checkpoint_path is not None:
+            # created AFTER the resume restore (nothing may be in flight
+            # while slots are being read); multi-host keeps the sync path
+            # — the orbax save is a collective and must stay on the main
+            # thread of every process
+            if jax.process_count() > 1:
+                log("WARNING: async_checkpoint is single-process only; "
+                    "multi-host runs keep the synchronous save")
+            elif self._ckpt_writer is None:
+                from federated_pytorch_test_tpu.utils.checkpoint import (
+                    AsyncCheckpointWriter,
+                )
+                self._ckpt_writer = AsyncCheckpointWriter()
+
         obs = self._open_obs(resumed=resume_at is not None,
                              rounds_prior=len(history))
         obs_images = cfg.Nepoch * self._obs_epoch_images()
@@ -1218,90 +1448,115 @@ class BlockwiseFederatedTrainer:
                                    if cfg.update_guard else 0)
                         loss_acc = None       # on-device [K] accumulator: the
                         stage_s = 0.0         # host fetch happens ONCE per round
-                        t_train = time.perf_counter()
-                        for nepoch in range(cfg.Nepoch):
+                        dispatch0 = self._host_dispatches
+                        run_fused = (self._use_fused and algo.communicates
+                                     and n_comm > 0)
+                        if run_fused:
+                            # fused round (cfg.fused_rounds): ONE dispatch
+                            # scans the Nepoch epochs and runs the comm
+                            # update behind them; the [Nepoch, 2] seed
+                            # stage is the round's only H2D traffic.  The
+                            # whole round is one program, so the dispatch
+                            # lands in train_seconds and comm_seconds
+                            # reads 0 (PARITY.md timing note)
                             t_stage = time.perf_counter()
-                            xb, yb, wb = self._stage_epoch(
-                                last=(nloop == cfg.Nloop - 1
-                                      and ci == self.L - 1
-                                      and nadmm == cfg.Nadmm - 1
-                                      and nepoch == cfg.Nepoch - 1))
-                            keys = self._epoch_keys()
-                            self._obs_sync(obs, xb, yb, wb, keys)
+                            seeds = self._fused_epoch_seeds()
+                            gbound = self._round_gbound()
+                            self._obs_sync(obs, seeds)
                             stage_s += time.perf_counter() - t_stage
-                            state, losses = train_epoch(
-                                state, y, self.client_norm, keys,
-                                xb, yb, wb, z, rho, active)
-                            loss_acc = (losses if loss_acc is None
-                                        else loss_acc + losses)
-                            if cfg.be_verbose:
-                                # per-client epoch losses (the reference's
-                                # be_verbose minibatch prints,
-                                # federated_multi.py:199-200) — the only path
-                                # that syncs the host inside the epoch loop
-                                log(f"verbose: block={ci} nadmm={nadmm} "
-                                    f"epoch={nepoch} client_loss="
-                                    + np.array2string(fetch(losses),
-                                                      precision=4))
-                        # obs phase segments: with obs recording, each
-                        # boundary drains the dispatch queue (_obs_sync) so
-                        # stage/train/comm measure execution; with obs off
-                        # the syncs vanish and the segments are wall-clock
-                        # between the round's single host sync — see README
-                        # "Observability" and PARITY.md
-                        self._obs_sync(obs, state, loss_acc)
-                        train_s = time.perf_counter() - t_train - stage_s
-                        t_comm = time.perf_counter()
-                        if algo.communicates and n_comm > 0:
-                            if cfg.bb_update and nadmm == 0:
-                                mode = "bb_store"
-                            elif (cfg.bb_update and nadmm > 0
-                                  and nadmm % cfg.bb_period_T == 0):
-                                mode = "bb"
-                            else:
-                                mode = "plain"
-                            out = comm_fns[mode](
-                                state, z, y, rho, x0, yhat0, comm_active,
-                                corrupt, self._round_gbound())
+                            t_train = time.perf_counter()
+                            mode = self._comm_mode(nadmm)
+                            out = self._build_fused(ci)[mode](
+                                state, z, y, rho, x0, yhat0, active,
+                                comm_active, corrupt, gbound, seeds,
+                                self.client_norm, *self._dev_x,
+                                self._dev_w)
+                            self._host_dispatches += 1
                             if cfg.update_guard:
-                                state, z, y, rho, x0, yhat0, diag, okf = out
+                                (state, z, y, rho, x0, yhat0, diag, okf,
+                                 loss_acc) = out
                             else:
-                                state, z, y, rho, x0, yhat0, diag = out
+                                (state, z, y, rho, x0, yhat0, diag,
+                                 loss_acc) = out
                             diag = {k: float(v) for k, v in diag.items()}
                             if cfg.update_guard:
-                                # quarantine this round's offenders (active AND
-                                # rejected — okf alone cannot tell a rejected
-                                # client from one that never participated),
-                                # tick running sentences down one round, and
-                                # fold the accepted delta-norm scale into the
-                                # guard bound (EMA; first clean round seeds it)
-                                okf_h = np.asarray(fetch(okf))
-                                tripped = (comm_host > 0) & (okf_h < 0.5)
-                                self._quarantine = np.maximum(
-                                    self._quarantine - 1, 0)
-                                if cfg.quarantine_rounds > 0:
-                                    self._quarantine[tripped] = \
-                                        cfg.quarantine_rounds
-                                if diag.get("n_ok", 0.0) > 0:
-                                    nm = diag["guard_norm_mean"]
-                                    self._guard_scale = (
-                                        nm
-                                        if not np.isfinite(self._guard_scale)
-                                        else 0.5 * self._guard_scale + 0.5 * nm)
-                        elif algo.communicates:
-                            # every client dropped/quarantined out of the
-                            # exchange: degrade gracefully — no collective runs,
-                            # z/y/rho carry over unchanged and the round is
-                            # still recorded (and still serves quarantine time)
-                            diag = {"n_active": 0.0}
-                            if cfg.update_guard:
-                                diag.update(guard_trips=0.0, n_ok=0.0)
-                                self._quarantine = np.maximum(
-                                    self._quarantine - 1, 0)
+                                self._apply_guard_verdicts(
+                                    diag, okf, comm_host)
+                            self._obs_sync(obs, state, z, y, loss_acc)
+                            train_s = time.perf_counter() - t_train
+                            comm_s = 0.0
                         else:
-                            diag = {}
-                        self._obs_sync(obs, state, z, y)
-                        comm_s = time.perf_counter() - t_comm
+                            t_train = time.perf_counter()
+                            for nepoch in range(cfg.Nepoch):
+                                t_stage = time.perf_counter()
+                                xb, yb, wb = self._stage_epoch(
+                                    last=(nloop == cfg.Nloop - 1
+                                          and ci == self.L - 1
+                                          and nadmm == cfg.Nadmm - 1
+                                          and nepoch == cfg.Nepoch - 1))
+                                keys = self._epoch_keys()
+                                self._obs_sync(obs, xb, yb, wb, keys)
+                                stage_s += time.perf_counter() - t_stage
+                                state, losses = train_epoch(
+                                    state, y, self.client_norm, keys,
+                                    xb, yb, wb, z, rho, active)
+                                self._host_dispatches += 1
+                                loss_acc = (losses if loss_acc is None
+                                            else loss_acc + losses)
+                                if cfg.be_verbose:
+                                    # per-client epoch losses (the
+                                    # reference's be_verbose minibatch
+                                    # prints, federated_multi.py:199-200)
+                                    # — the only path that syncs the host
+                                    # inside the epoch loop
+                                    log(f"verbose: block={ci} "
+                                        f"nadmm={nadmm} "
+                                        f"epoch={nepoch} client_loss="
+                                        + np.array2string(fetch(losses),
+                                                          precision=4))
+                            # obs phase segments: with obs recording, each
+                            # boundary drains the dispatch queue
+                            # (_obs_sync) so stage/train/comm measure
+                            # execution; with obs off the syncs vanish and
+                            # the segments are wall-clock between the
+                            # round's single host sync — see README
+                            # "Observability" and PARITY.md
+                            self._obs_sync(obs, state, loss_acc)
+                            train_s = (time.perf_counter() - t_train
+                                       - stage_s)
+                            t_comm = time.perf_counter()
+                            if algo.communicates and n_comm > 0:
+                                mode = self._comm_mode(nadmm)
+                                out = comm_fns[mode](
+                                    state, z, y, rho, x0, yhat0,
+                                    comm_active, corrupt,
+                                    self._round_gbound())
+                                if cfg.update_guard:
+                                    (state, z, y, rho, x0, yhat0, diag,
+                                     okf) = out
+                                else:
+                                    state, z, y, rho, x0, yhat0, diag = out
+                                diag = {k: float(v)
+                                        for k, v in diag.items()}
+                                if cfg.update_guard:
+                                    self._apply_guard_verdicts(
+                                        diag, okf, comm_host)
+                            elif algo.communicates:
+                                # every client dropped/quarantined out of
+                                # the exchange: degrade gracefully — no
+                                # collective runs, z/y/rho carry over
+                                # unchanged and the round is still
+                                # recorded (and still serves quarantine
+                                # time)
+                                diag = {"n_active": 0.0}
+                                if cfg.update_guard:
+                                    diag.update(guard_trips=0.0, n_ok=0.0)
+                                    self._quarantine = np.maximum(
+                                        self._quarantine - 1, 0)
+                            else:
+                                diag = {}
+                            self._obs_sync(obs, state, z, y)
+                            comm_s = time.perf_counter() - t_comm
                         t_sync = time.perf_counter()
                         # single host sync per round: the loss fetch depends on
                         # every epoch in the chain and the diag/rho floats on
@@ -1321,6 +1576,11 @@ class BlockwiseFederatedTrainer:
                                    comm_seconds=comm_s,
                                    sync_seconds=sync_s,
                                    **fcounts, **diag)
+                        # train-phase dispatches this round: Nepoch on the
+                        # per-epoch loop, exactly 1 when fused — the
+                        # tentpole's tracked metric
+                        rec["host_dispatches"] = (self._host_dispatches
+                                                  - dispatch0)
                         if self._sentinel is not None:
                             # cumulative traces-beyond-first: flat in steady
                             # state, growing when something retraces
@@ -1336,6 +1596,28 @@ class BlockwiseFederatedTrainer:
                         if cfg.check_results:
                             rec["accuracy"] = self.evaluate(state)
                         history.append(rec)
+                        if checkpoint_path is not None:
+                            if nadmm + 1 < cfg.Nadmm:
+                                nxt = (nloop, ci, nadmm + 1)
+                            elif ci + 1 < self.L:
+                                nxt = (nloop, ci + 1, 0)
+                            else:
+                                nxt = (nloop + 1, 0, 0)
+                            # checkpoint BEFORE the obs emit so the round
+                            # record carries its own write cost; under
+                            # --async-checkpoint this times only the D2H
+                            # snapshot + queue handoff (the serialize +
+                            # sha256 + rotation run on the writer thread)
+                            # no device sync wanted here: the sync save
+                            # materializes every leaf via np.asarray (its
+                            # own sync) and the async save deliberately
+                            # times only the host-side snapshot + enqueue
+                            t_ckpt = time.perf_counter()  # graftlint: disable=JG104
+                            self._save_midrun(checkpoint_path, state,
+                                              (z, y, rho, x0, yhat0), nxt,
+                                              history)
+                            rec["ckpt_write_seconds"] = (
+                                time.perf_counter() - t_ckpt)
                         if obs.enabled:
                             extra = dict(rec, round_index=len(history) - 1,
                                          images=obs_images,
@@ -1346,16 +1628,6 @@ class BlockwiseFederatedTrainer:
                                 extra["bytes_dense"] = 4 * N * int(
                                     diag.get("n_active", cfg.K))
                             obs.round(extra)
-                        if checkpoint_path is not None:
-                            if nadmm + 1 < cfg.Nadmm:
-                                nxt = (nloop, ci, nadmm + 1)
-                            elif ci + 1 < self.L:
-                                nxt = (nloop, ci + 1, 0)
-                            else:
-                                nxt = (nloop + 1, 0, 0)
-                            self._save_midrun(checkpoint_path, state,
-                                              (z, y, rho, x0, yhat0), nxt,
-                                              history)
                         blk = self.block_ids[ci]
                         msg = (f"block=[{blk[0]},{blk[1]}]({N},{float(rho):f}) "
                                f"round={nadmm}/{nloop} "
@@ -1367,6 +1639,10 @@ class BlockwiseFederatedTrainer:
                         if on_round is not None:
                             on_round(state, rec)
         obs.close()
+        # write barrier on run exit: every queued async checkpoint must be
+        # durable before the caller sees the run as finished (a failed
+        # background save surfaces HERE, not silently)
+        self._flush_ckpt_writer()
         return state, history
 
     def run_independent(self, state: Optional[ClientState] = None,
@@ -1403,8 +1679,10 @@ class BlockwiseFederatedTrainer:
             state, losses = train_epoch(state, y, self.client_norm,
                                         self._epoch_keys(), xb, yb, wb, z,
                                         rho, self._ones_mask)
+            self._host_dispatches += 1
             rec = dict(epoch=epoch, loss=float(np.sum(fetch(losses))),
-                       epoch_seconds=time.perf_counter() - t_epoch)
+                       epoch_seconds=time.perf_counter() - t_epoch,
+                       host_dispatches=1)
             if self._sentinel is not None:
                 rec["jit_retraces"] = self._sentinel.retraces
             if cfg.check_results:

@@ -3,6 +3,13 @@
 The reference ships these as two more copies of the driver skeleton
 (federated_vae.py, federated_vae_cl.py); here they are small subclasses of
 :class:`BlockwiseFederatedTrainer` overriding the workload hooks.
+
+Because they override only the workload hooks, the engine's execution
+machinery is inherited wholesale — including ``--fused-rounds`` (the
+per-epoch reparametrisation PRNG keys these losses consume are derived
+on-device inside the fused round from the same counter-keyed seeds the
+host loop uses, so fused VAE rounds stay bit-identical), ``--donate``
+buffer donation, and ``--async-checkpoint`` background mid-run saves.
 """
 
 from __future__ import annotations

@@ -221,6 +221,88 @@ def save_checkpoint_swapped(path: str, tree,
     _barrier("fedtpu:ckpt:swapped")
 
 
+def snapshot_to_host(tree):
+    """Device pytree -> host numpy pytree, with the D2H copies overlapped.
+
+    Every jax leaf's ``copy_to_host_async()`` is kicked off FIRST so the
+    transfers run concurrently, then each is materialized with
+    ``np.asarray`` (which merely waits on the in-flight copy).  The result
+    aliases nothing on device — safe to hand to a background writer while
+    the next round donates/overwrites the source buffers.  Non-array
+    leaves (ints, None) pass through untouched.
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    for leaf in leaves:
+        if hasattr(leaf, "copy_to_host_async"):
+            leaf.copy_to_host_async()
+    host = [np.asarray(leaf) if hasattr(leaf, "copy_to_host_async") else leaf
+            for leaf in leaves]
+    return jax.tree.unflatten(treedef, host)
+
+
+class AsyncCheckpointWriter:
+    """Background serialize+sha256+rotate for :func:`save_checkpoint_swapped`.
+
+    One daemon worker thread drains a submission queue, so writes are
+    strictly ordered — the queue IS the rotation barrier: slot surgery for
+    save N always completes before save N+1 touches the directory.  The
+    caller snapshots device state to host (``snapshot_to_host``) BEFORE
+    submitting, so the round loop never blocks on disk.
+
+    ``wait()`` is the write barrier (run exit / pre-restore); a failed
+    background save re-raises there, and also on the next ``submit`` so a
+    broken disk can't silently drop every subsequent checkpoint.
+    Single-process only: multi-host orbax saves are collectives and must
+    stay on the main thread (callers fall back to the sync path).
+    """
+
+    def __init__(self, max_pending: int = 1):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="ckpt-writer")
+        self._pending: List[Any] = []
+        self._max_pending = max(1, int(max_pending))
+        self._closed = False
+
+    def _reap(self, block: bool) -> None:
+        while self._pending:
+            fut = self._pending[0]
+            if not (block or fut.done()):
+                return
+            self._pending.pop(0)
+            fut.result()          # re-raise a background failure here
+
+    def submit(self, path: str, tree, meta=None) -> None:
+        """Queue one swapped save of an already-host-resident ``tree``.
+
+        Backpressure: blocks only when more than ``max_pending`` older
+        saves are still in flight (a slow disk degrades toward the sync
+        path instead of queueing unbounded snapshots in host RAM).
+        """
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointWriter is closed")
+        while len(self._pending) >= self._max_pending:
+            self._reap(block=True)
+        self._reap(block=False)
+        self._pending.append(
+            self._pool.submit(save_checkpoint_swapped, path, tree, meta))
+
+    def wait(self) -> None:
+        """Block until every queued save is durable (re-raising failures)."""
+        self._reap(block=True)
+
+    def close(self) -> None:
+        """``wait()`` then shut the worker down; idempotent."""
+        if self._closed:
+            return
+        try:
+            self.wait()
+        finally:
+            self._closed = True
+            self._pool.shutdown(wait=True)
+
+
 def pack_history(history) -> np.ndarray:
     """Host history records -> a uint8 buffer orbax can store as a leaf."""
     import pickle

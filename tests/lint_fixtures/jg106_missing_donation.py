@@ -1,4 +1,9 @@
-"""Fixture: triggers exactly JG106 (state update without donation)."""
+"""Fixture: triggers exactly JG106 (state update without donation).
+
+JG106 is WARNING severity: a state-carrying jit site must either donate,
+spell ``donate_argnums=()``, or carry a ``graftlint: disable=JG106``
+suppression explaining why the caller keeps the input buffers alive.
+"""
 import jax
 
 

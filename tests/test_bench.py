@@ -48,7 +48,7 @@ class TestAcquireBackend:
                                            backoff=7.0)
         assert "after 3 probes" in err and "hung" in err
         assert used == 3                     # every probe consumed, recorded
-        assert sleeps == [7.0, 7.0]                         # between probes
+        assert sleeps == [7.0, 14.0]       # exponential, between probes
         assert os.environ["JAX_PLATFORMS"] == "cpu"
         assert os.environ["PALLAS_AXON_POOL_IPS"] == ""
 

@@ -42,13 +42,19 @@ class TestGraftcheckClean:
 
         assert load_baseline(BASELINE) == set()
 
-    def test_advisory_findings_are_advice_only(self):
-        """JG106 (donation) stays advisory by design: the engines' round
-        fns alias state across calls (init_state reuses params0) and the
-        CPU test backend ignores donation, so the advice is reported but
-        must never fail the default gate."""
+    def test_jg106_is_warning_and_tree_has_none(self):
+        """JG106 (donation) was promoted from advice to WARNING once the
+        engines went donation-safe end to end (init_state deep-copies
+        params0; every state-carrying jit site donates or carries an
+        explicit suppression), so the shipped tree must have ZERO JG106
+        findings — suppressed sites don't count, unsuppressed ones fail
+        the default gate like any other warning."""
+        from federated_pytorch_test_tpu.analysis.rules import MissingDonation
+
+        assert MissingDonation.severity is Severity.WARNING
         result = LintEngine(ALL_RULES).lint_paths(TARGETS)
-        assert all(f.severity == Severity.ADVICE for f in result.findings)
+        jg106 = [f for f in result.findings if f.rule_id == "JG106"]
+        assert jg106 == [], "\n".join(f.render() for f in jg106)
 
 
 @pytest.mark.skipif(shutil.which("ruff") is None,

@@ -41,14 +41,9 @@ class TestFixtures:
 
     @pytest.mark.parametrize("name,rule_id", CASES)
     def test_cli_exits_nonzero(self, name, rule_id, capsys):
-        # JG106 is advisory: visible at --fail-on advice, clean at the
-        # default gate — which is exactly why the shipped tree's JG106
-        # findings don't fail test_lint_clean.py
-        args = [str(FIXTURES / name)]
-        if rule_id == "JG106":
-            assert lint_main(args) == 0
-            args += ["--fail-on", "advice"]
-        assert lint_main(args) == 1
+        # every rule — JG106 included, warning severity since the engine
+        # went donation-safe end to end — fails the default gate
+        assert lint_main([str(FIXTURES / name)]) == 1
         capsys.readouterr()
 
     def test_fixture_set_covers_every_rule(self):

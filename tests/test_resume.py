@@ -49,8 +49,14 @@ def strip(rec):
 
 
 class TestMidrunResume:
-    def test_killed_run_resumes_to_identical_history(self, data, tmp_path):
-        cfg = small_cfg()
+    # both checkpoint write paths honor the kill/resume contract: the
+    # async writer's abort-path drain makes the last submitted round
+    # durable before the trainer dies, exactly like the sync save
+    @pytest.mark.parametrize("async_ckpt", [False, True],
+                             ids=["sync", "async"])
+    def test_killed_run_resumes_to_identical_history(self, data, tmp_path,
+                                                     async_ckpt):
+        cfg = small_cfg(async_checkpoint=async_ckpt)
         ck = str(tmp_path / "ck")
 
         _, hist_full = run_trainer(cfg, data)
@@ -313,7 +319,7 @@ class TestCorruptSlotFallback:
             fh.seek(0)
             fh.write(bytes([b[0] ^ 0xFF]))
 
-    def _bombed_run_with_slots(self, data, ck):
+    def _bombed_run_with_slots(self, data, ck, **cfg_kw):
         """Kill after round 1 so BOTH ck (round 1) and ck.old (round 0)
         checkpoint slots exist when the resume probes them."""
         def bomb(state, rec):
@@ -321,15 +327,20 @@ class TestCorruptSlotFallback:
                 raise Killed
 
         with pytest.raises(Killed):
-            run_trainer(small_cfg(), data, checkpoint_path=ck,
+            run_trainer(small_cfg(**cfg_kw), data, checkpoint_path=ck,
                         on_round=bomb)
 
-    def test_corrupt_primary_falls_back_to_old_slot(self, data, tmp_path):
+    # the async writer must preserve the slot protocol (rotation order,
+    # sha256 sidecars) byte-for-byte — the corrupt-slot walk is the proof
+    @pytest.mark.parametrize("async_ckpt", [False, True],
+                             ids=["sync", "async"])
+    def test_corrupt_primary_falls_back_to_old_slot(self, data, tmp_path,
+                                                    async_ckpt):
         import os
 
         ck = str(tmp_path / "ck")
         _, hist_full = run_trainer(small_cfg(), data)
-        self._bombed_run_with_slots(data, ck)
+        self._bombed_run_with_slots(data, ck, async_checkpoint=async_ckpt)
         assert os.path.isdir(ck + ".old")
         self._corrupt_slot(ck)
 
