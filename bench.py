@@ -379,6 +379,13 @@ def _bench_round(trainer, ci, *, reps, with_comm=False, with_staging=False,
         # cumulative across the trainer: any growth between sections
         # means a timed region recompiled mid-measurement
         fields["jit_retraces"] = trainer._sentinel.retraces
+    # sync/async throughput must be distinguishable in the artifact:
+    # async rounds skip the per-round barrier, so their img/s is not
+    # comparable to a synchronous number with the same label
+    fields["async_mode"] = bool(trainer.cfg.async_rounds)
+    if trainer.cfg.async_rounds:
+        fields["max_staleness"] = int(trainer.cfg.max_staleness)
+        fields["admission_rejected"] = int(trainer._async_rejected)
     if with_comm and trainer.algo.communicates:
         fields["bytes_on_wire"] = reps * trainer.round_bytes_on_wire(N, K)
         fields["bytes_dense"] = reps * 4 * N * K

@@ -1,4 +1,4 @@
-"""graftcheck rule set (JG101-JG106).
+"""graftcheck rule set (JG101-JG107).
 
 All rules share one per-module :class:`JitIndex` that answers "which
 functions execute under a jit trace, and which of their parameters are
@@ -106,6 +106,7 @@ class JitIndex:
     static_by_fn: Dict[ast.AST, Set[str]]        # root fn -> static params
     numpy_aliases: Set[str]
     jitted_bindings: Dict[str, JitSite]
+    fn_by_scope: Dict[Tuple[ast.AST, str], ast.AST]
 
     def enclosing_fn(self, node: ast.AST) -> Optional[ast.AST]:
         cur = self.parents.get(node)
@@ -306,7 +307,8 @@ def build_index(module: ModuleContext) -> JitIndex:
     index = JitIndex(parents=parents, sites=sites, contexts=contexts,
                      static_by_fn=roots, numpy_aliases=numpy_aliases or
                      {"numpy", "np", "onp"},
-                     jitted_bindings=jitted_bindings)
+                     jitted_bindings=jitted_bindings,
+                     fn_by_scope=fn_by_scope)
     module._graft_index = index
     return index
 
@@ -734,6 +736,168 @@ class MissingDonation(Rule):
                 "when the caller must keep the input buffers alive)")
 
 
+# ------------------------------------------------------------------- JG107
+
+def _axes_from_mesh_call(call: ast.Call) -> Optional[Set[str]]:
+    """Axis names of a ``Mesh(devices, axis_names)`` construction, or None
+    when the call is not a Mesh / the names are not string literals
+    (``client_mesh()`` and friends stay opaque on purpose)."""
+    if _last_name(call.func) != "Mesh":
+        return None
+    names: Tuple[str, ...] = ()
+    for kw in call.keywords:
+        if kw.arg == "axis_names":
+            names = _const_strs(kw.value)
+    if not names and len(call.args) > 1:
+        names = _const_strs(call.args[1])
+    return set(names) or None
+
+
+def _mesh_axes(mesh_expr: Optional[ast.AST],
+               tree: ast.Module) -> Optional[Set[str]]:
+    """Statically-known axis names of the mesh expression, else None."""
+    if isinstance(mesh_expr, ast.Call):
+        return _axes_from_mesh_call(mesh_expr)
+    if not isinstance(mesh_expr, ast.Name):
+        return None                       # self.mesh etc: unknown
+    axes: Optional[Set[str]] = None
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == mesh_expr.id):
+            if not isinstance(node.value, ast.Call):
+                return None
+            got = _axes_from_mesh_call(node.value)
+            if got is None:
+                return None               # one opaque rebinding: unknown
+            axes = (axes or set()) | got
+    return axes
+
+
+def _module_str_constant(tree: ast.Module, name: str) -> Optional[str]:
+    """Value of a module-level ``NAME = "literal"`` binding, if unique."""
+    val = None
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == name
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            val = node.value.value
+    return val
+
+
+def _iter_p_calls(expr: ast.AST, tree: ast.Module,
+                  _resolve: bool = True) -> Iterator[ast.Call]:
+    """P(...) / PartitionSpec(...) calls inside a specs expression.
+
+    A Name element (``spec_c`` built earlier) is resolved one level deep
+    through ``name = P(...)`` assignments anywhere in the module — the
+    engines build their specs once per builder function.
+    """
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        if (isinstance(node, ast.Call)
+                and _last_name(node.func) in ("P", "PartitionSpec")):
+            yield node
+            continue
+        if isinstance(node, ast.Name) and _resolve:
+            for asg in ast.walk(tree):
+                if (isinstance(asg, ast.Assign) and len(asg.targets) == 1
+                        and isinstance(asg.targets[0], ast.Name)
+                        and asg.targets[0].id == node.id):
+                    yield from _iter_p_calls(asg.value, tree, _resolve=False)
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _spec_axis_names(expr: ast.AST, tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for call in _iter_p_calls(expr, tree):
+        for a in call.args:
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                out.add(a.value)
+            elif isinstance(a, ast.Name):
+                v = _module_str_constant(tree, a.id)
+                if v is not None:
+                    out.add(v)
+    return out
+
+
+class ShardingAnnotation(Rule):
+    """Error severity: both defects are guaranteed runtime failures — a
+    wrong ``in_specs`` arity raises inside shard_map's argument zip, and
+    an axis name the mesh doesn't define raises at lowering — but only
+    when that code path finally executes, which for the engines' cached
+    per-block builders can be minutes into a TPU run."""
+
+    id = "JG107"
+    severity = Severity.ERROR
+    summary = "shard_map in_specs/out_specs disagree with callable or mesh"
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        index = build_index(module)
+        tree = module.tree
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and _last_name(node.func) == "shard_map" and node.args):
+                continue
+            kw = {k.arg: k.value for k in node.keywords if k.arg}
+            in_specs = kw.get("in_specs") or (
+                node.args[2] if len(node.args) > 2 else None)
+            out_specs = kw.get("out_specs") or (
+                node.args[3] if len(node.args) > 3 else None)
+            mesh_expr = kw.get("mesh") or (
+                node.args[1] if len(node.args) > 1 else None)
+            yield from self._check_arity(module, index, node, in_specs)
+            yield from self._check_axes(module, tree, node, mesh_expr,
+                                        in_specs, out_specs)
+
+    def _check_arity(self, module, index, node,
+                     in_specs) -> Iterator[Finding]:
+        # only a literal tuple/list pins the arity; a single spec is a
+        # pytree-prefix broadcast and a Name is opaque
+        if not isinstance(in_specs, (ast.Tuple, ast.List)):
+            return
+        scope = _enclosing_scope(index.parents, node)
+        fn, bound_kw, bound_pos = _resolve_callable(
+            node.args[0], scope, index.parents, index.fn_by_scope)
+        if fn is None or fn.args.vararg is not None:
+            return                        # lambda / foreign fn / *args
+        names = _fn_param_names(fn)
+        n_max = len(names) - bound_pos - len(bound_kw & set(names))
+        n_defaults = len(fn.args.defaults)
+        n_specs = len(in_specs.elts)
+        if not (n_max - n_defaults <= n_specs <= n_max):
+            want = (str(n_max) if n_defaults == 0
+                    else f"{n_max - n_defaults}..{n_max}")
+            yield self.finding(
+                module, in_specs,
+                f"in_specs has {n_specs} entries but "
+                f"{getattr(fn, 'name', '<fn>')!r} takes {want} positional "
+                "argument(s) after partial binding — shard_map will raise "
+                "when this call site finally executes")
+
+    def _check_axes(self, module, tree, node, mesh_expr, in_specs,
+                    out_specs) -> Iterator[Finding]:
+        axes = _mesh_axes(mesh_expr, tree)
+        if not axes:
+            return                        # mesh not statically known
+        for label, expr in (("in_specs", in_specs),
+                            ("out_specs", out_specs)):
+            if expr is None:
+                continue
+            unknown = sorted(_spec_axis_names(expr, tree) - axes)
+            if unknown:
+                yield self.finding(
+                    module, expr,
+                    f"{label} names mesh axis "
+                    f"{', '.join(repr(u) for u in unknown)} but the mesh "
+                    f"defines only {sorted(axes)} — lowering raises on the "
+                    "undefined axis")
+
+
 ALL_RULES: Sequence[Rule] = (
     HostSyncInJit(),
     TracedBranch(),
@@ -741,4 +905,5 @@ ALL_RULES: Sequence[Rule] = (
     TimerNoSync(),
     RecompileHazard(),
     MissingDonation(),
+    ShardingAnnotation(),
 )

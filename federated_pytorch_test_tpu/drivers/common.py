@@ -53,7 +53,9 @@ def build_parser(defaults: FederatedConfig, prog: str) -> argparse.ArgumentParse
                 arg, type=str, default=default, metavar="SPEC",
                 help="fault-injection spec: 'none' or "
                      "drop=P,straggle=P,corrupt=P,mode=nan|inf|signflip|"
-                     "scale,scale=X,seed=N,clients=i+j (train/faults.py)")
+                     "scale|innerprod|collude,scale=X,seed=N,clients=i+j,"
+                     "delay=P,delay_max=N (train/faults.py; delay= drives "
+                     "--async-rounds arrival times)")
         elif f.name == "model":
             p.add_argument(arg, choices=MODEL_CHOICES, default=default)
         elif default is None:

@@ -32,8 +32,14 @@ from typing import Any, Dict
 # — wall-clock the round spent in the mid-run save call (async
 # checkpointing: snapshot+enqueue only, so near zero unless the writer's
 # backpressure barrier engaged).
-# v1/v2 records remain valid: validate_record accepts ver <= SCHEMA_VERSION.
-SCHEMA_VERSION = 3
+# v4 (additive): buffered-async federation telemetry (--async-rounds) —
+# per-round `async_mode`/`max_staleness` (the mode stamp), `async_arrived`
+# (deliveries this round), `admission_rejected` (staler than
+# max_staleness, discarded), `buffer_depth` (updates still in flight
+# after the round), and `staleness_hist` (admitted deliveries bucketed by
+# staleness 0..max_staleness).
+# v1..v3 records remain valid: validate_record accepts ver <= SCHEMA_VERSION.
+SCHEMA_VERSION = 4
 
 EVENTS = ("run_header", "round", "summary")
 
@@ -117,6 +123,13 @@ FIELDS: Dict[str, Any] = {
     "fault_dropped": (("round",), _INT),
     "fault_straggled": (("round",), _INT),
     "fault_corrupted": (("round",), _INT),
+    # buffered-async federation (schema v4; --async-rounds)
+    "async_mode":   (("round",), _BOOL),
+    "max_staleness": (("round",), _INT),
+    "async_arrived": (("round",), _INT),
+    "admission_rejected": (("round",), _INT),
+    "buffer_depth": (("round",), _INT),
+    "staleness_hist": (("round",), _LIST),
     # device memory (absent when the backend reports none, e.g. CPU)
     "mem_bytes_in_use": (("round",), _INT),
     "mem_peak_bytes_in_use": (("round",), _INT),

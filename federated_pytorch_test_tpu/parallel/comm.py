@@ -30,7 +30,11 @@ from federated_pytorch_test_tpu.parallel.mesh import CLIENT_AXIS
 
 #: CLI surface — drivers/common.py derives --robust-agg choices from this
 #: so the flag and the factory cannot drift.
-ROBUST_AGG_CHOICES = ("none", "trim", "median", "clip")
+ROBUST_AGG_CHOICES = ("none", "trim", "median", "clip", "krum", "geomed")
+
+#: Weiszfeld iterations for kind="geomed" — static so the estimator jits
+#: to a fixed program; 16 is ample for the post-trim deltas we feed it.
+GEOMED_ITERS = 16
 
 
 def federated_sum(tree, axis_name: str = CLIENT_AXIS):
@@ -117,18 +121,38 @@ def robust_federated_mean(x: jnp.ndarray, w=None, *, kind: str,
       averaged.  Bounds the damage of a scaled (magnitude) attack to a
       ``clip_mult``-sized pull; does NOT defend against direction-only
       attacks (sign flips survive with unit scale).
+    - ``krum``: multi-Krum selection (Blanchard et al., NeurIPS'17) —
+      each client is scored by the summed squared distance to its
+      ``m - f - 2`` nearest active neighbours with ``f = floor(
+      trim_frac * m)`` the assumed attacker count, and the ``m - f``
+      best-scored clients are averaged.  Selection is per-CLIENT, not
+      per-coordinate, so coordinated colluders (identical copies that
+      out-vote trim/median coordinate-wise) are discarded whole as
+      long as ``f`` covers the colluding subset... with the standard
+      caveat that a large enough identical cluster is also maximally
+      mutually-near; keep ``trim_frac`` above the colluding fraction.
+    - ``geomed``: geometric median via ``GEOMED_ITERS`` fixed
+      Weiszfeld iterations from the weighted-mean start.  Rotation-
+      invariant breakdown point 1/2 in the per-client (not per-
+      coordinate) sense — the minimiser of summed distances cannot be
+      dragged far by any minority, coordinated or not.
 
     Defensive by construction against non-finite updates: a client row
     containing any NaN/Inf is folded out of the weight vector entirely
     (it cannot be ranked), so a poisoned update never reaches the sort
-    or the sum.  ``w`` ([K_local] 0/1 activity weights) masks
+    or the sum.  ``w`` ([K_local] activity weights — 0/1 masks, or
+    fractional staleness weights under ``--async-rounds``) masks
     participation the same way; inactive rows are keyed to ``+inf`` and
     excluded by the dynamic trim window, never multiplied (``0 * inf``
-    would manufacture the NaN this function exists to stop).  An
-    all-rejected round returns the zero vector — the engine's guard
+    would manufacture the NaN this function exists to stop).  Rank
+    windows (trim/median/krum) count rows with ``w > 0`` — a
+    downweighted straggler still occupies one rank slot — while the
+    surviving rows are averaged with their actual weights, so for 0/1
+    weights every estimator is bit-identical to the unweighted form.
+    An all-rejected round returns the zero vector — the engine's guard
     layer (train/engine.py) carries ``z`` over in that case.
     """
-    if kind not in ("trim", "median", "clip"):
+    if kind not in ROBUST_AGG_CHOICES[1:]:
         raise ValueError(f"unknown robust aggregation {kind!r}; expected "
                          f"one of {ROBUST_AGG_CHOICES[1:]}")
     xg = lax.all_gather(x, axis_name, tiled=True)            # [K, N]
@@ -139,20 +163,68 @@ def robust_federated_mean(x: jnp.ndarray, w=None, *, kind: str,
         wg = lax.all_gather(w, axis_name, tiled=True)        # [K]
     finite = jax.vmap(lambda v: jnp.all(jnp.isfinite(v)))(xg)
     wg = wg * finite.astype(xg.dtype)
-    m = jnp.sum(wg)                                          # active count
+    act = wg > 0
+    m = jnp.sum(act.astype(xg.dtype))                        # active count
+    wsum = jnp.sum(wg)                                       # active weight
 
     if kind == "clip":
         safe = jnp.where(finite[:, None], xg, 0.0)
         nrm = jax.vmap(jnp.linalg.norm)(safe)
         c = clip_mult * _masked_median(nrm, wg)
         scl = jnp.where(nrm > c, c / jnp.maximum(nrm, 1e-30), 1.0)
-        clipped = jnp.where(wg[:, None] > 0, safe * scl[:, None], 0.0)
-        return jnp.sum(clipped, axis=0) / jnp.maximum(m, 1.0)
+        clipped = jnp.where(act[:, None], wg[:, None] * safe * scl[:, None],
+                            0.0)
+        return jnp.sum(clipped, axis=0) / jnp.where(wsum > 0, wsum, 1.0)
+
+    if kind == "krum":
+        safe = jnp.where(act[:, None], xg, 0.0)
+        sq = jnp.sum(safe * safe, axis=1)
+        d2 = jnp.maximum(sq[:, None] + sq[None, :] - 2.0 * (safe @ safe.T),
+                         0.0)                                # [K, K]
+        # self-distances and inactive columns can never be neighbours
+        d2 = jnp.where(jnp.eye(K, dtype=bool) | ~act[None, :], jnp.inf, d2)
+        f = jnp.floor(trim_frac * m)
+        n_nb = jnp.maximum(m - f - 2.0, 1.0)
+        posr = jnp.arange(K, dtype=xg.dtype)[None, :]
+        ds = jnp.sort(d2, axis=1)
+        score = jnp.sum(jnp.where(posr < n_nb, ds, 0.0), axis=1)
+        # m == 1 leaves a lone client with no finite neighbour: clamp its
+        # +inf score so the selection below still picks it
+        score = jnp.where(act, jnp.minimum(score, 1e30), jnp.inf)
+        idx = jnp.arange(K)
+        better = ((score[None, :] < score[:, None])
+                  | ((score[None, :] == score[:, None])
+                     & (idx[None, :] < idx[:, None])))
+        rank = jnp.sum(better.astype(xg.dtype), axis=1)
+        sel = (rank < jnp.maximum(m - f, 1.0)) & act
+        num = jnp.sum(jnp.where(sel[:, None], wg[:, None] * safe, 0.0),
+                      axis=0)
+        den = jnp.sum(jnp.where(sel, wg, 0.0))
+        return num / jnp.where(den > 0, den, 1.0)
+
+    if kind == "geomed":
+        safe = jnp.where(act[:, None], xg, 0.0)
+        v0 = (jnp.sum(safe * wg[:, None], axis=0)
+              / jnp.where(wsum > 0, wsum, 1.0))
+
+        def _weiszfeld(v, _):
+            r = jnp.sqrt(jnp.sum((safe - v[None, :]) ** 2, axis=1))
+            inv = wg / jnp.maximum(r, 1e-8)
+            den = jnp.sum(inv)
+            vn = (jnp.sum(safe * inv[:, None], axis=0)
+                  / jnp.where(den > 0, den, 1.0))
+            return vn, None
+
+        v, _ = lax.scan(_weiszfeld, v0, None, length=GEOMED_ITERS)
+        return v
 
     # sort-based estimators: key inactive/non-finite rows to +inf so the
     # active values occupy the first m sorted positions per coordinate
-    key = jnp.where(wg[:, None] > 0, xg, jnp.inf)
-    s = jnp.sort(key, axis=0)                                # [K, N]
+    key = jnp.where(act[:, None], xg, jnp.inf)
+    order = jnp.argsort(key, axis=0)                         # [K, N]
+    s = jnp.take_along_axis(key, order, axis=0)
+    sw = jnp.take_along_axis(
+        jnp.broadcast_to(wg[:, None], key.shape), order, axis=0)
     pos = jnp.arange(K, dtype=xg.dtype)[:, None]
     if kind == "median":
         lo = jnp.floor((m - 1.0) / 2.0)
@@ -163,9 +235,9 @@ def robust_federated_mean(x: jnp.ndarray, w=None, *, kind: str,
     else:                                                    # trim
         t = jnp.floor(trim_frac * m)
         inc = (pos >= t) & (pos < m - t)
-    cnt = jnp.sum(inc[:, 0])
-    return (jnp.sum(jnp.where(inc, s, 0.0), axis=0)
-            / jnp.maximum(cnt, 1.0))
+    den = jnp.sum(jnp.where(inc, sw, 0.0), axis=0)
+    return (jnp.sum(jnp.where(inc, sw * s, 0.0), axis=0)
+            / jnp.where(den > 0, den, 1.0))
 
 
 def _masked_median(v: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
@@ -181,12 +253,13 @@ def _masked_median(v: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
 
 def make_robust_mean(kind: str, *, trim_frac: float = 0.1,
                      clip_mult: float = 3.0, axis_name: str = CLIENT_AXIS):
-    """Factory behind ``--robust-agg {none,trim,median,clip}``.
+    """Factory behind ``--robust-agg`` (choices = ``ROBUST_AGG_CHOICES``).
 
     Returns ``None`` for ``"none"`` (the algorithms then keep their
     LITERAL plain-mean path — reference parity), else a ``(stack, w) ->
     aggregate`` callable handed to ``Algorithm.global_update`` as
-    ``mean_fn``.  Validated here so a bad flag fails at trainer
+    ``mean_fn``.  ``trim_frac`` doubles as krum's assumed attacker
+    fraction ``f/m``.  Validated here so a bad flag fails at trainer
     construction, not mid-run inside jit.
     """
     if kind not in ROBUST_AGG_CHOICES:

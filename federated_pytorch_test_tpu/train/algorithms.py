@@ -41,11 +41,14 @@ def _active_mean(x: jnp.ndarray, w, K: int) -> jnp.ndarray:
     if w is None:
         return federated_mean(x, K)
     n_act = lax.psum(jnp.sum(w), CLIENT_AXIS)
-    # max(n, 1): an all-rejected guard round (train/engine.py update
+    # where(n > 0): an all-rejected guard round (train/engine.py update
     # guards) has n_act == 0 — return the zero vector instead of 0/0 NaN;
     # the engine then carries z over.  Unreachable under participation
-    # sampling alone (>= 1 client is always kept).
-    return federated_sum(w[:, None] * x) / jnp.maximum(n_act, 1.0)
+    # sampling alone (>= 1 client is always kept).  A where-select, not
+    # max(n, 1): async staleness weights are fractional, and a round
+    # whose only arrivals are downweighted (0 < n_act < 1) must still
+    # divide by the true weight sum to stay a convex combination.
+    return federated_sum(w[:, None] * x) / jnp.where(n_act > 0, n_act, 1.0)
 
 
 class Algorithm:

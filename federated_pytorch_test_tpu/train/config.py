@@ -78,9 +78,11 @@ class FederatedConfig:
     # fault injection (train/faults.py): deterministic, seeded, replayable
     # per-client per-round faults — dropout, straggler delay (local epochs
     # withheld, stale update shipped), update corruption (nan/inf/
-    # signflip/scale) at the encode(x_k - z) boundary.  "none" = no
-    # faults (reference parity).  Grammar:
-    #   drop=P,straggle=P,corrupt=P,mode=M,scale=X,seed=N,clients=i+j
+    # signflip/scale elementwise; innerprod/collude coordinated) at the
+    # encode(x_k - z) boundary, and late delivery (delay=, async mode
+    # only).  "none" = no faults (reference parity).  Grammar:
+    #   drop=P,straggle=P,corrupt=P,mode=M,scale=X,seed=N,clients=i+j,
+    #   delay=P,delay_max=N
     fault_spec: str = "none"
 
     # robust aggregation (parallel/comm.py robust_federated_mean):
@@ -88,9 +90,12 @@ class FederatedConfig:
     # trimmed mean ("trim", trims trim_frac per side; tolerates an
     # attacker fraction < trim_frac), coordinate median ("median",
     # breakdown ~1/2), norm-clipped mean ("clip", clips every client to
-    # clip_mult x the median active norm).  "none" = the literal dense
-    # psum mean (reference parity).
-    robust_agg: str = "none"       # none|trim|median|clip
+    # clip_mult x the median active norm), multi-Krum selection ("krum",
+    # averages the m - f closest-to-their-neighbours clients with
+    # f = floor(trim_frac * m) — survives coordinated colluders), and
+    # the Weiszfeld geometric median ("geomed", per-client breakdown
+    # ~1/2).  "none" = the literal dense psum mean (reference parity).
+    robust_agg: str = "none"       # one of comm.ROBUST_AGG_CHOICES
     trim_frac: float = 0.1
     clip_mult: float = 3.0
 
@@ -109,6 +114,24 @@ class FederatedConfig:
     update_guard: bool = False
     guard_norm_mult: float = 10.0
     quarantine_rounds: int = 1
+
+    # buffered-asynchronous federation (train/engine.py
+    # _round_activity_async): the server stops barriering per round —
+    # each client's update is dispatched when it finishes local work and
+    # spends a seeded number of rounds in transit (fault_spec delay=
+    # family), the server folds updates in AS THEY ARRIVE with
+    # staleness-decayed weights w = (1 + s)^(-staleness_alpha), and an
+    # admission controller rejects anything staler than max_staleness
+    # rounds.  A client with an update in flight does not start new
+    # work (one outstanding update per client — the "buffer" is the
+    # frozen client params themselves).  Deterministic given the seed,
+    # and resume-stable: the staleness ledger rides in the mid-run
+    # checkpoint.  Off by default — the synchronous barrier path stays
+    # bit-identical.  Incompatible with bb_update (the BB spectral
+    # history assumes lockstep rounds).
+    async_rounds: bool = False
+    max_staleness: int = 4         # admission cutoff, in comm rounds
+    staleness_alpha: float = 0.5   # polynomial decay exponent (0 = flat)
 
     # adaptive-ADMM Barzilai-Borwein knobs (consensus_multi.py:41-47)
     bb_update: bool = False

@@ -149,6 +149,18 @@ class BlockwiseFederatedTrainer:
                 "bb_update: both can mask clients out of a round, and the "
                 "BB spectral history (x0/yhat0 deltas) assumes every "
                 "client moves every round (consensus_multi.py:242-278)")
+        if cfg.async_rounds:
+            if cfg.bb_update:
+                raise ValueError(
+                    "async_rounds is incompatible with bb_update: the BB "
+                    "spectral history assumes every client moves in "
+                    "lockstep rounds (consensus_multi.py:242-278)")
+            if cfg.max_staleness < 0:
+                raise ValueError(
+                    f"max_staleness={cfg.max_staleness} must be >= 0")
+            if cfg.staleness_alpha < 0:
+                raise ValueError(
+                    f"staleness_alpha={cfg.staleness_alpha} must be >= 0")
         if cfg.quarantine_rounds < 0:
             raise ValueError(
                 f"quarantine_rounds={cfg.quarantine_rounds} must be >= 0")
@@ -161,6 +173,15 @@ class BlockwiseFederatedTrainer:
         # Both ride in the mid-run checkpoint meta so resume replays them.
         self._quarantine = np.zeros(cfg.K, np.int64)
         self._guard_scale = float("inf")
+        # buffered-async staleness ledger (cfg.async_rounds): per-client
+        # scheduled arrival round (-1 = nothing in flight) and dispatch
+        # round of the in-flight update, plus the cumulative admission-
+        # rejection count.  Host state like the quarantine ledger — it
+        # rides in the mid-run checkpoint meta so a resumed run replays
+        # the identical arrival schedule (_round_activity_async).
+        self._async_arrival = np.full(cfg.K, -1, np.int64)
+        self._async_birth = np.zeros(cfg.K, np.int64)
+        self._async_rejected = 0
 
         self.order = model.param_order()
         self.block_ids = model.train_order_block_ids()
@@ -475,10 +496,13 @@ class BlockwiseFederatedTrainer:
         # all, so the reference-parity path compiles exactly as before.
         # Fault injection and update guards reuse the same plumbing (a
         # dropped/quarantined client IS a non-participant), so either
-        # turns the masked mode on too.
+        # turns the masked mode on too — as does async mode, where the
+        # activity vector carries the fractional staleness weights of the
+        # round's arrivals (_round_activity_async).
         faults_on = self.faults.enabled
         guard_on = cfg.update_guard
-        partial = cfg.participation < 1.0 or faults_on or guard_on
+        partial = (cfg.participation < 1.0 or faults_on or guard_on
+                   or cfg.async_rounds)
         has_corrupt = faults_on and self.faults.corrupt > 0
         corrupt_mode, corrupt_scale = self.faults.mode, self.faults.scale
         mean_fn = self.mean_fn
@@ -522,9 +546,13 @@ class BlockwiseFederatedTrainer:
                 # fault injection happens at the encode(x_k - z) boundary:
                 # the wire delta is poisoned BEFORE compression, exactly
                 # where a faulty client corrupts a real deployment — the
-                # compressor (and its EF residual) sees the poisoned delta
+                # compressor (and its EF residual) sees the poisoned delta.
+                # active/CLIENT_AXIS feed the collective modes (innerprod/
+                # collude) their cross-client honest/colluder means; the
+                # elementwise modes ignore both.
                 x = z[None, :] + apply_corruption(
-                    x - z[None, :], corrupt, corrupt_mode, corrupt_scale)
+                    x - z[None, :], corrupt, corrupt_mode, corrupt_scale,
+                    w=active, axis_name=CLIENT_AXIS)
             comp_state = state.comp
             if compressed:
                 # uplink-compress the update delta d_k = x_k - z; the
@@ -930,8 +958,14 @@ class BlockwiseFederatedTrainer:
         The fast path (no faults, nothing quarantined) returns the staged
         participation mask untouched — the reference-parity round stages
         the exact arrays it always did.
+
+        Under ``cfg.async_rounds`` the buffered-async scheduler takes
+        over (``_round_activity_async``): ``comm`` then carries the
+        round's FRACTIONAL staleness weights instead of a 0/1 mask.
         """
         cfg, faults = self.cfg, self.faults
+        if cfg.async_rounds:
+            return self._round_activity_async(nloop, ci, nadmm)
         quarantined = int(np.sum(self._quarantine > 0))
         if not faults.enabled and quarantined == 0:
             if cfg.participation >= 1.0:
@@ -959,6 +993,84 @@ class BlockwiseFederatedTrainer:
         csh = client_sharding(self.mesh)
         return (stage_global(train, csh), stage_global(comm, csh),
                 stage_global(corrupt, csh), comm, counts)
+
+    def _round_activity_async(self, nloop: int, ci: int, nadmm: int):
+        """Buffered-async round schedule (cfg.async_rounds).
+
+        The server stops barriering: a free client sampled this round
+        DISPATCHES — it runs its local epochs now and its update spends
+        ``faults.round_delays`` rounds in transit (the frozen client
+        params ARE the in-flight buffer; the client is masked out of
+        train AND comm until delivery, so there is exactly one
+        outstanding update per client).  Deliveries scheduled for this
+        round pass the bounded-staleness admission controller
+        (``staleness <= cfg.max_staleness``, rejects discarded and
+        counted) and join the exchange with polynomially decayed weights
+        ``w = (1 + s)^(-staleness_alpha)`` — exactly 1.0 at staleness 0,
+        so a no-delay async run aggregates like the synchronous path.
+
+        Same return contract as ``_round_activity`` except ``comm`` /
+        ``comm_host`` carry the fractional admission weights and
+        ``counts`` gains the async telemetry (``async_arrived``,
+        ``admission_rejected``, ``buffer_depth``, ``staleness_hist``).
+        Every draw is stateless in the round coordinates and the ledger
+        rides in the checkpoint meta, so fresh runs and mid-run resumes
+        replay bit-identically.  Updates still in flight when the block
+        rotates are void (the flat block vector changes meaning) — the
+        ledger resets with the block, like the guard scale.
+        """
+        cfg, faults = self.cfg, self.faults
+        K = cfg.K
+        base = (np.ones(K, np.float32) if cfg.participation >= 1.0
+                else self._participation_host(nloop, ci, nadmm))
+        ok = 1.0 - (self._quarantine > 0).astype(np.float32)
+        drop = straggle = corrupt = np.zeros(K, np.float32)
+        if faults.enabled:
+            drop, straggle, corrupt = faults.round_faults(
+                K, nloop, ci, nadmm)
+        free = (self._async_arrival < 0).astype(np.float32)
+        # dispatchers: free clients sampled this round that didn't drop.
+        # A straggler still dispatches — its training is withheld, so the
+        # update in flight is its round-start params (the sync stale-
+        # update semantics, now also late).
+        dispatch = base * ok * (1.0 - drop) * free
+        train = dispatch * (1.0 - straggle)
+        delays = faults.round_delays(K, nloop, ci, nadmm)
+        d_idx = dispatch > 0
+        self._async_arrival[d_idx] = nadmm + delays[d_idx]
+        self._async_birth[d_idx] = nadmm
+        # deliveries scheduled for THIS round (a delay-0 dispatch arrives
+        # in its own round — the synchronous limit)
+        arrive = self._async_arrival == nadmm
+        stale = np.where(arrive, nadmm - self._async_birth, 0)
+        admit = arrive & (stale <= cfg.max_staleness)
+        reject = arrive & ~admit
+        w = np.zeros(K, np.float32)
+        w[admit] = (1.0 + stale[admit]) ** (-cfg.staleness_alpha)
+        # every delivery retires its slot — admitted or rejected, the
+        # client is free to be sampled again next round
+        self._async_arrival[arrive] = -1
+        self._async_rejected += int(reject.sum())
+        # corruption poisons the wire at DELIVERY time (the encode
+        # boundary runs when the server ingests the update)
+        corrupt = corrupt * admit.astype(np.float32)
+        hist = np.bincount(stale[admit].astype(np.int64),
+                           minlength=cfg.max_staleness + 1)
+        counts = {
+            "n_comm": int(admit.sum()),
+            "async_arrived": int(arrive.sum()),
+            "admission_rejected": int(reject.sum()),
+            "buffer_depth": int(np.sum(self._async_arrival >= 0)),
+            "staleness_hist": [int(c) for c in hist],
+        }
+        if faults.enabled:
+            counts.update(
+                fault_dropped=int(np.sum(base * ok * free * drop)),
+                fault_straggled=int(np.sum(dispatch * straggle)),
+                fault_corrupted=int(np.sum(corrupt)))
+        csh = client_sharding(self.mesh)
+        return (stage_global(train, csh), stage_global(w, csh),
+                stage_global(corrupt, csh), w, counts)
 
     def _round_gbound(self):
         """Staged replicated norm bound for the update guard: no bound
@@ -1155,6 +1267,14 @@ class BlockwiseFederatedTrainer:
             # run would readmit an offender early / drop the bound
             meta["quarantine"] = np.asarray(self._quarantine, np.int64)
             meta["guard_scale"] = np.asarray(self._guard_scale, np.float64)
+        if self.cfg.async_rounds:
+            # the staleness ledger is host state the same way: losing it
+            # would re-dispatch clients whose updates are in flight and
+            # deliver nothing they promised
+            meta["async_arrival"] = np.asarray(self._async_arrival, np.int64)
+            meta["async_birth"] = np.asarray(self._async_birth, np.int64)
+            meta["async_rejected"] = np.asarray(self._async_rejected,
+                                                np.int64)
         if self._ckpt_writer is not None:
             # async path: materialize a host copy NOW (donation-safe — the
             # device buffers may be donated away by the very next round's
@@ -1217,6 +1337,17 @@ class BlockwiseFederatedTrainer:
             else:           # checkpoint predates the guards: start clean
                 self._quarantine = np.zeros(self.cfg.K, np.int64)
                 self._guard_scale = float("inf")
+        if self.cfg.async_rounds:
+            if "async_arrival" in meta:
+                self._async_arrival = np.asarray(meta["async_arrival"],
+                                                 np.int64)
+                self._async_birth = np.asarray(meta["async_birth"],
+                                               np.int64)
+                self._async_rejected = int(meta["async_rejected"])
+            else:           # checkpoint predates async mode: empty buffer
+                self._async_arrival = np.full(self.cfg.K, -1, np.int64)
+                self._async_birth = np.zeros(self.cfg.K, np.int64)
+                self._async_rejected = 0
         # a pending prefetched epoch stays valid across restore IF its
         # counter matches (epochs are pure functions of the counter);
         # _stage_epoch's counter check handles both cases
@@ -1433,6 +1564,10 @@ class BlockwiseFederatedTrainer:
                     # fresh block => fresh delta scale: the guard norm
                     # bound recalibrates (no bound until one clean round)
                     self._guard_scale = float("inf")
+                    # fresh block => in-flight updates are void: the flat
+                    # block vector they promise no longer exists
+                    self._async_arrival = np.full(cfg.K, -1, np.int64)
+                    self._async_birth = np.zeros(cfg.K, np.int64)
 
                 for nadmm in range(nadmm_start, cfg.Nadmm):
                     # one XProf step per comm round, keyed on the
@@ -1622,6 +1757,9 @@ class BlockwiseFederatedTrainer:
                             extra = dict(rec, round_index=len(history) - 1,
                                          images=obs_images,
                                          **device_memory_stats())
+                            if cfg.async_rounds:
+                                extra["async_mode"] = True
+                                extra["max_staleness"] = cfg.max_staleness
                             if algo.communicates:
                                 # dense comparator for the wire bytes: every
                                 # participant's f32 block payload

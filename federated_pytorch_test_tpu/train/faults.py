@@ -30,15 +30,34 @@ engine ``_round_mask``).
 Spec grammar (``--fault-spec``)::
 
     none
-    drop=P,straggle=P,corrupt=P,mode=M,scale=X,seed=N,clients=i+j+k
+    drop=P,straggle=P,corrupt=P,mode=M,scale=X,seed=N,clients=i+j+k,
+    delay=P,delay_max=N
 
 ``P`` are independent per-client per-round probabilities; ``mode`` is
-one of ``nan | inf | signflip | scale`` (default ``scale``); ``scale``
-is the multiplier for ``mode=scale`` (default 100); ``clients``
-restricts fault eligibility to the listed client indices (default: all
-— ``clients=0`` with ``corrupt=1`` is the classic "one Byzantine
+one of ``nan | inf | signflip | scale | innerprod | collude`` (default
+``scale``); ``scale`` is the multiplier for ``mode=scale`` (default
+100) and the magnitude for the collective modes; ``clients`` restricts
+fault eligibility to the listed client indices (default: all —
+``clients=0`` with ``corrupt=1`` is the classic "one Byzantine
 client" adversary).  Precedence per client per round: drop beats
 straggle beats corrupt (a dead client cannot also send garbage).
+
+The collective modes model adaptive adversaries that stay inside the
+norm envelope: ``innerprod`` replaces each corrupted delta with
+``-scale x`` the honest clients' mean delta (maximally negative inner
+product with the aggregate direction), and ``collude`` replaces every
+corrupted delta with the IDENTICAL ``scale x`` mean of the colluding
+subset — coordinated copies that defeat coordinate-wise trim/median
+but not selection-based estimators (krum/geomed).
+
+``delay=P`` is the late-delivery family: when a client's update is
+dispatched it spends a geometric number of extra rounds in transit
+(continuation probability ``P`` per round, per-client heterogeneity
+factor drawn once from the seed, capped at ``delay_max``, default 8).
+Delays only matter under ``--async-rounds`` (the synchronous barrier
+waits for everyone, so delay is inert there); unlike the failure
+families they are NOT restricted by ``clients=`` — latency is a
+property of the network, not of the adversary.
 """
 
 from __future__ import annotations
@@ -48,8 +67,9 @@ from typing import NamedTuple, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
-CORRUPT_MODES = ("nan", "inf", "signflip", "scale")
+CORRUPT_MODES = ("nan", "inf", "signflip", "scale", "innerprod", "collude")
 
 
 class RoundFaults(NamedTuple):
@@ -71,15 +91,23 @@ class FaultSpec:
     scale: float = 100.0
     seed: int = 0
     clients: Optional[Tuple[int, ...]] = None   # None = every client eligible
+    delay: float = 0.0          # per-round in-transit continuation probability
+    delay_max: int = 8          # staleness cap on any single delivery
 
     @property
     def enabled(self) -> bool:
-        return self.drop > 0 or self.straggle > 0 or self.corrupt > 0
+        return (self.drop > 0 or self.straggle > 0 or self.corrupt > 0
+                or self.delay > 0)
 
     @property
     def masking(self) -> bool:
         """Does this spec ever change the round activity masks?"""
         return self.drop > 0 or self.straggle > 0
+
+    @property
+    def delaying(self) -> bool:
+        """Does this spec ever put an update in transit (async mode only)?"""
+        return self.delay > 0
 
     @classmethod
     def parse(cls, spec: Optional[str]) -> "FaultSpec":
@@ -102,6 +130,18 @@ class FaultSpec:
                 if not 0.0 <= p <= 1.0:
                     raise ValueError(f"fault-spec {key}={p} outside [0, 1]")
                 kw[key] = p
+            elif key == "delay":
+                p = float(val)
+                if not 0.0 <= p < 1.0:
+                    raise ValueError(
+                        f"fault-spec delay={p} outside [0, 1) (a continuation "
+                        "probability of 1 would never deliver)")
+                kw[key] = p
+            elif key == "delay_max":
+                n = int(val)
+                if n < 0:
+                    raise ValueError(f"fault-spec delay_max={n} is negative")
+                kw[key] = n
             elif key == "mode":
                 if val not in CORRUPT_MODES:
                     raise ValueError(f"fault-spec mode={val!r}; expected one "
@@ -124,7 +164,7 @@ class FaultSpec:
         if not out.enabled:
             raise ValueError(
                 f"fault-spec {spec!r} names no fault probability "
-                "(set drop/straggle/corrupt, or pass 'none')")
+                "(set drop/straggle/corrupt/delay, or pass 'none')")
         return out
 
     def round_faults(self, K: int, nloop: int, ci: int, nadmm: int
@@ -153,15 +193,48 @@ class FaultSpec:
                    * eligible * (1.0 - drop) * (1.0 - straggle))
         return RoundFaults(drop, straggle, corrupt)
 
+    def round_delays(self, K: int, nloop: int, ci: int, nadmm: int
+                     ) -> np.ndarray:
+        """[K] int64 in-transit round counts for updates DISPATCHED at
+        round ``(nloop, ci, nadmm)``; 0 means same-round delivery.
+
+        Two seeded streams compose the draw: a per-client heterogeneity
+        factor in [0.5, 1.5] fixed for the whole run (tag ``53`` — some
+        clients sit on persistently slower links), and a per-round
+        geometric draw (tag ``61``) stateless in the round coordinates,
+        so fresh runs and mid-run resumes replay the identical arrival
+        schedule.  ``P(delay >= d) = p_k^d`` with ``p_k = clip(delay *
+        het_k, 0, 0.99)``, capped at ``delay_max``.  NOT gated by
+        ``clients=`` (see module docstring).
+        """
+        if self.delay <= 0.0 or self.delay_max <= 0:
+            return np.zeros(K, np.int64)
+        het = np.random.default_rng([self.seed, 53]).uniform(0.5, 1.5, K)
+        p = np.clip(self.delay * het, 0.0, 0.99)
+        u = np.random.default_rng(
+            [self.seed, 61, nloop, ci, nadmm]).random(K)
+        with np.errstate(divide="ignore"):
+            d = np.floor(np.log(np.maximum(u, 1e-300))
+                         / np.log(np.maximum(p, 1e-300)))
+        d = np.where(p > 0.0, d, 0.0)
+        return np.clip(d, 0, self.delay_max).astype(np.int64)
+
 
 def apply_corruption(delta: jnp.ndarray, corrupt: jnp.ndarray, mode: str,
-                     scale: float) -> jnp.ndarray:
+                     scale: float, w: Optional[jnp.ndarray] = None,
+                     axis_name: Optional[str] = None) -> jnp.ndarray:
     """Corrupt the client-stacked update deltas ``[K_local, N]``.
 
     ``corrupt`` is the per-client 0/1 indicator ``[K_local]``; ``mode``
     and ``scale`` are static (one compiled program per spec).  Uses
     elementwise selects, never masked arithmetic, so a NaN/Inf payload
     cannot leak into the untouched clients' rows.
+
+    The collective modes (``innerprod``/``collude``) need cross-client
+    means: ``w`` is the per-client activity/weight vector (None = all
+    active) and ``axis_name`` the mesh axis to psum over (None = the
+    local stack holds every client — unit-test path).  The elementwise
+    modes ignore both.
     """
     c = corrupt.reshape((-1,) + (1,) * (delta.ndim - 1)) > 0
     if mode == "nan":
@@ -172,4 +245,25 @@ def apply_corruption(delta: jnp.ndarray, corrupt: jnp.ndarray, mode: str,
         return jnp.where(c, -delta, delta)
     if mode == "scale":
         return jnp.where(c, scale * delta, delta)
+    if mode in ("innerprod", "collude"):
+        act = jnp.ones_like(corrupt) if w is None else w
+        if mode == "innerprod":
+            # mean of the HONEST active deltas — the direction the
+            # aggregate wants to move; corrupted rows flip against it.
+            sel = act * (1.0 - corrupt)
+            sgn = -scale
+        else:
+            # mean of the COLLUDING subset — every colluder then ships
+            # the identical scaled copy (coordinated, not independent).
+            sel = act * corrupt
+            sgn = scale
+        selc = sel.reshape(c.shape) > 0
+        num = jnp.sum(jnp.where(selc, sel.reshape(c.shape) * delta, 0.0),
+                      axis=0)
+        den = jnp.sum(sel)
+        if axis_name is not None:
+            num = lax.psum(num, axis_name)
+            den = lax.psum(den, axis_name)
+        g = num / jnp.where(den > 0, den, 1.0)
+        return jnp.where(c, sgn * g[None, ...], delta)
     raise ValueError(f"unknown corruption mode {mode!r}")

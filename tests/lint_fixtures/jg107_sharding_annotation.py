@@ -1,0 +1,20 @@
+"""Fixture: triggers exactly JG107 (sharding-annotation mismatch).
+
+Two defects, both JG107: ``in_specs`` carries three entries for a
+two-parameter body, and ``out_specs`` names an axis the mesh does not
+define.  Either one raises at runtime — but only when the call site
+finally executes, which is the point of catching it statically.
+"""
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), ("data",))
+
+
+def body(a, b):
+    return a + b
+
+
+out = jax.shard_map(body, mesh=mesh,
+                    in_specs=(P("data"), P("data"), P()),
+                    out_specs=P("model"))

@@ -1,0 +1,206 @@
+"""Buffered-async federation runtime tests (ISSUE 6, ``--async-rounds``).
+
+The mode's contract, asserted here:
+
+- OFF (the default) is bit-identical to the synchronous engine — and
+  async with no ``delay=`` spec is the synchronous limit (every dispatch
+  arrives in its own round with weight exactly 1.0).
+- ON, the server applies updates as they arrive: one outstanding update
+  per client (the frozen round-start params ARE the in-flight buffer),
+  a bounded-staleness admission controller (staleness > max_staleness is
+  discarded and counted), and staleness-weighted mixing
+  ``w = (1 + s) ** -staleness_alpha`` composed with the robust
+  estimators.
+- Deterministic given the seed: arrival times come from the stateless
+  ``delay=`` fault family keyed on the round coordinates, so a rerun —
+  or a mid-run resume (tests/test_resume.py) — replays bit-identically.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import flax.linen as nn
+
+from federated_pytorch_test_tpu.data.cifar10 import FederatedCifar10
+from federated_pytorch_test_tpu.models.base import (
+    BlockModule,
+    elu,
+    flatten,
+    max_pool_2x2,
+    pairs,
+)
+from federated_pytorch_test_tpu.obs.schema import (
+    SCHEMA_VERSION,
+    validate_record,
+)
+from federated_pytorch_test_tpu.train import (
+    AdmmConsensus,
+    BlockwiseFederatedTrainer,
+    FedAvg,
+    FederatedConfig,
+)
+
+pytestmark = pytest.mark.asyncfl
+
+K = 4
+
+
+class TinyNet(BlockModule):
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = max_pool_2x2(elu(nn.Conv(4, (5, 5), strides=(2, 2),
+                                     name="conv1")(x)))
+        x = flatten(x)
+        return nn.Dense(10, name="fc1")(x)
+
+    def param_order(self):
+        return pairs("conv1", "fc1")
+
+    def train_order_block_ids(self):
+        return [[0, 1], [2, 3]]
+
+    def linear_layer_ids(self):
+        return [1]
+
+
+@pytest.fixture(scope="module")
+def data():
+    return FederatedCifar10(K=K, batch=16, limit_per_client=32,
+                            limit_test=32)
+
+
+def small_cfg(**kw):
+    base = dict(K=K, Nloop=1, Nepoch=1, Nadmm=2, default_batch=16,
+                check_results=False, admm_rho0=0.1)
+    base.update(kw)
+    return FederatedConfig(**base)
+
+
+def run_trainer(cfg, data, algo=None, L=1, **run_kw):
+    t = BlockwiseFederatedTrainer(TinyNet(), cfg, data, algo or FedAvg())
+    t.L = L
+    return t, t.run(log=lambda m: None, **run_kw)
+
+
+DELAYED = dict(async_rounds=True, max_staleness=2,
+               fault_spec="delay=0.5,delay_max=2,seed=7")
+
+
+class TestSyncLimit:
+    def test_async_off_by_default(self):
+        assert FederatedConfig().async_rounds is False
+
+    def test_async_no_delay_matches_sync_bitwise(self, data):
+        # no delay spec: every dispatch arrives with staleness 0 and
+        # weight exactly 1.0 — the losses match the sync engine bit for
+        # bit, only the telemetry fields differ
+        cfg_s = small_cfg(Nadmm=3)
+        cfg_a = small_cfg(Nadmm=3, async_rounds=True)
+        _, (_, hs) = run_trainer(cfg_s, data)
+        _, (_, ha) = run_trainer(cfg_a, data)
+        assert [r["loss"] for r in hs] == [r["loss"] for r in ha]
+        assert [r["dual_residual"] for r in hs] == \
+            [r["dual_residual"] for r in ha]
+        assert all(r["async_arrived"] == K and r["buffer_depth"] == 0
+                   and r["staleness_hist"][0] == K for r in ha)
+
+
+class TestBufferedRounds:
+    def test_seeded_run_replays_bit_identically(self, data):
+        cfg = small_cfg(Nadmm=6, **DELAYED)
+        _, (_, h1) = run_trainer(cfg, data, AdmmConsensus())
+        _, (_, h2) = run_trainer(cfg, data, AdmmConsensus())
+        for a, b in zip(h1, h2):
+            assert a["loss"] == b["loss"]
+            assert a["n_active"] == b["n_active"]
+            assert a["staleness_hist"] == b["staleness_hist"]
+
+    def test_one_outstanding_update_per_client(self, data):
+        # conservation: in-flight buffer + this round's deliveries never
+        # exceed K, and a client with an update in flight is not
+        # re-dispatched (buffer_depth counts distinct clients)
+        cfg = small_cfg(Nadmm=6, **DELAYED)
+        _, (_, hist) = run_trainer(cfg, data)
+        for rec in hist:
+            assert 0 <= rec["buffer_depth"] <= K
+            assert rec["async_arrived"] + rec["buffer_depth"] <= K
+            assert sum(rec["staleness_hist"]) + \
+                rec["admission_rejected"] == rec["async_arrived"]
+
+    def test_staleness_weight_formula(self, data):
+        # n_active is the psum of the admitted staleness weights, so it
+        # must equal sum_s hist[s] * (1 + s) ** -alpha exactly (within
+        # float32): the documented polynomial-decay mixing
+        for alpha in (0.0, 1.0):
+            cfg = small_cfg(Nadmm=6, staleness_alpha=alpha, **DELAYED)
+            _, (_, hist) = run_trainer(cfg, data)
+            for rec in hist:
+                want = sum(n * (1.0 + s) ** -alpha
+                           for s, n in enumerate(rec["staleness_hist"]))
+                np.testing.assert_allclose(rec["n_active"], want,
+                                           rtol=1e-6, err_msg=str(rec))
+
+    def test_admission_controller_rejects_stale(self, data):
+        # max_staleness=0 with delays up to 2: every late delivery must
+        # be discarded and counted, and the cumulative trainer ledger
+        # must match the per-round records
+        cfg = small_cfg(Nadmm=6, async_rounds=True, max_staleness=0,
+                        fault_spec="delay=0.7,delay_max=2,seed=3")
+        t, (_, hist) = run_trainer(cfg, data)
+        rejected = sum(r["admission_rejected"] for r in hist)
+        assert rejected > 0
+        assert t._async_rejected == rejected
+        for rec in hist:
+            assert len(rec["staleness_hist"]) == 1          # 0..max
+            assert np.isfinite(rec["loss"])
+
+    def test_delay_composes_with_drop_and_corrupt(self, data):
+        # the full fault family in one async run: drops suppress
+        # dispatch, corruption fires at delivery, and the guard keeps
+        # the model finite throughout
+        cfg = small_cfg(
+            Nadmm=6, async_rounds=True, max_staleness=3,
+            fault_spec="drop=0.2,corrupt=0.3,mode=scale,scale=50,"
+                       "delay=0.4,delay_max=2,seed=5",
+            update_guard=True, robust_agg="geomed")
+        t, (state, hist) = run_trainer(cfg, data)
+        assert all(np.isfinite(r["loss"]) for r in hist)
+        import jax
+        for leaf in jax.tree.leaves(jax.device_get(state.params)):
+            assert np.all(np.isfinite(leaf))
+
+    def test_fused_rounds_compose_with_async(self, data):
+        cfg_u = small_cfg(Nadmm=4, **DELAYED)
+        cfg_f = small_cfg(Nadmm=4, fused_rounds=True, **DELAYED)
+        _, (_, hu) = run_trainer(cfg_u, data, AdmmConsensus())
+        _, (_, hf) = run_trainer(cfg_f, data, AdmmConsensus())
+        for a, b in zip(hu, hf):
+            np.testing.assert_allclose(a["loss"], b["loss"], rtol=1e-5)
+            assert a["staleness_hist"] == b["staleness_hist"]
+
+
+class TestAsyncObsArtifact:
+    def test_round_records_carry_v4_fields_and_validate(self, data,
+                                                        tmp_path):
+        cfg = small_cfg(Nadmm=3, obs_dir=str(tmp_path), obs_sinks="jsonl",
+                        **DELAYED)
+        run_trainer(cfg, data)
+        files = [f for f in os.listdir(tmp_path) if f.endswith(".jsonl")]
+        assert len(files) == 1
+        recs = [json.loads(line) for line in
+                open(os.path.join(tmp_path, files[0]))]
+        rounds = [r for r in recs if r["event"] == "round"]
+        assert rounds
+        for rec in recs:
+            validate_record(rec)                 # schema v4 self-check
+        for rec in rounds:
+            assert rec["schema"] == SCHEMA_VERSION
+            assert rec["async_mode"] is True
+            assert rec["max_staleness"] == 2
+            assert isinstance(rec["async_arrived"], int)
+            assert isinstance(rec["admission_rejected"], int)
+            assert isinstance(rec["buffer_depth"], int)
+            assert len(rec["staleness_hist"]) == 3

@@ -117,6 +117,24 @@ class TestFaultSpecParse:
         spec = FaultSpec.parse("corrupt=1,mode=nan")
         assert spec.enabled and not spec.masking
 
+    def test_delay_grammar(self):
+        spec = FaultSpec.parse("delay=0.4,delay_max=3,seed=2")
+        assert spec.delay == 0.4 and spec.delay_max == 3
+        assert spec.enabled and spec.delaying and not spec.masking
+
+    def test_delay_only_spec_is_enabled(self):
+        # latency alone turns the harness on (needed for --async-rounds)
+        # but injects no drop/straggle/corrupt faults
+        spec = FaultSpec.parse("delay=0.2")
+        assert spec.enabled
+        rf = spec.round_faults(4, 0, 0, 0)
+        assert not rf.drop.any() and not rf.corrupt.any()
+
+    def test_new_corrupt_modes_parse(self):
+        for mode in ("innerprod", "collude"):
+            spec = FaultSpec.parse(f"corrupt=0.5,mode={mode},scale=3")
+            assert spec.mode == mode and spec.scale == 3.0
+
     @pytest.mark.parametrize("bad", [
         "drop",                        # not key=value
         "drop=1.5",                    # probability out of range
@@ -125,6 +143,9 @@ class TestFaultSpecParse:
         "corrupt=0.1,clients=",        # empty client list
         "corrupt=0.1,clients=-1",      # negative index
         "frobnicate=1",                # unknown key
+        "delay=1.0",                   # delay must stay below 1
+        "delay=-0.1",                  # negative delay
+        "delay=0.5,delay_max=-1",      # negative staleness cap
     ])
     def test_rejects(self, bad):
         with pytest.raises(ValueError):
@@ -171,6 +192,32 @@ class TestFaultSchedule:
         np.testing.assert_array_equal(
             rf.corrupt, np.asarray([0, 1, 0, 1, 0, 0], np.float32))
 
+    def test_round_delays_deterministic_and_capped(self):
+        a = FaultSpec(delay=0.6, delay_max=3, seed=5)
+        b = FaultSpec(delay=0.6, delay_max=3, seed=5)
+        seen = set()
+        for coords in [(0, 0, 0), (1, 0, 2), (3, 1, 5)]:
+            da, db = a.round_delays(8, *coords), b.round_delays(8, *coords)
+            np.testing.assert_array_equal(da, db)
+            assert da.dtype == np.int64
+            assert da.min() >= 0 and da.max() <= 3
+            seen.add(tuple(da))
+        assert len(seen) > 1               # the draw varies per round
+
+    def test_round_delays_zero_when_disabled(self):
+        for spec in (FaultSpec(), FaultSpec(delay=0.5, delay_max=0)):
+            np.testing.assert_array_equal(spec.round_delays(8, 0, 0, 0),
+                                          np.zeros(8, np.int64))
+
+    def test_delay_not_gated_by_clients(self):
+        # latency is a network property, not an adversary property: the
+        # clients= subset scopes corruption only, every client draws a delay
+        spec = FaultSpec(delay=0.9, delay_max=4, clients=(0,), seed=1)
+        hits = np.zeros(8, bool)
+        for r in range(16):
+            hits |= spec.round_delays(8, 0, 0, r) > 0
+        assert hits[1:].any()
+
 
 class TestApplyCorruption:
     def _delta(self):
@@ -195,6 +242,34 @@ class TestApplyCorruption:
         out = np.asarray(apply_corruption(d, c, mode, 100.0))
         np.testing.assert_array_equal(out[[1, 3]], np.asarray(d)[[1, 3]])
 
+    def test_innerprod_flips_against_honest_mean(self):
+        d = self._delta()
+        c = jnp.asarray([1.0, 0.0, 1.0, 0.0])
+        out = np.asarray(apply_corruption(d, c, "innerprod", 2.0))
+        honest = np.asarray(d)[[1, 3]].mean(axis=0)
+        np.testing.assert_allclose(out[0], -2.0 * honest, rtol=1e-6)
+        np.testing.assert_allclose(out[2], -2.0 * honest, rtol=1e-6)
+
+    def test_collude_ships_one_shared_scaled_copy(self):
+        d = self._delta()
+        c = jnp.asarray([1.0, 0.0, 1.0, 0.0])
+        out = np.asarray(apply_corruption(d, c, "collude", 5.0))
+        shared = 5.0 * np.asarray(d)[[0, 2]].mean(axis=0)
+        np.testing.assert_allclose(out[0], shared, rtol=1e-6)
+        np.testing.assert_array_equal(out[0], out[2])     # coordinated
+
+    def test_directed_modes_respect_participation_weights(self):
+        # an inactive honest client (w=0) must not contribute to the
+        # innerprod target; an inactive colluder contributes nothing to
+        # the shared copy
+        d = self._delta()
+        c = jnp.asarray([1.0, 0.0, 1.0, 0.0])
+        w = jnp.asarray([1.0, 1.0, 0.0, 0.0])
+        out = np.asarray(apply_corruption(d, c, "innerprod", 1.0, w=w))
+        np.testing.assert_allclose(out[0], -np.asarray(d)[1], rtol=1e-6)
+        out = np.asarray(apply_corruption(d, c, "collude", 1.0, w=w))
+        np.testing.assert_allclose(out[0], np.asarray(d)[0], rtol=1e-6)
+
 
 # ---------------------------------------------------------------------------
 # robust aggregation
@@ -207,6 +282,47 @@ def _run_robust(x, w, **kw):
         mesh=mesh, in_specs=(P(CLIENT_AXIS), P(CLIENT_AXIS)),
         out_specs=P(), check_vma=False)
     return np.asarray(jax.jit(fn)(jnp.asarray(x), jnp.asarray(w)))
+
+
+def _ref_krum(x, w, trim_frac):
+    """Closed-form multi-Krum: unweighted scores over active rows,
+    lexicographic (score, index) ranking, weighted average of the
+    selected m - f lowest-score rows."""
+    K = x.shape[0]
+    act = (w > 0) & np.isfinite(x).all(axis=1)
+    m = int(act.sum())
+    d2 = np.full((K, K), np.inf)
+    for i in range(K):
+        for j in range(K):
+            if i != j and act[j]:
+                d2[i, j] = float(np.sum((x[i] - x[j]) ** 2))
+    f = int(np.floor(trim_frac * m))
+    n_nb = max(m - f - 2, 1)
+    score = np.array([np.sort(d2[i])[:n_nb].sum() if act[i] else np.inf
+                      for i in range(K)])
+    order = np.lexsort((np.arange(K), score))
+    sel = order[:max(m - f, 1)]
+    sel = sel[act[sel]]
+    if sel.size == 0:
+        return np.zeros(x.shape[1], x.dtype)
+    ws = w[sel]
+    return (x[sel] * ws[:, None]).sum(axis=0) / ws.sum()
+
+
+def _ref_geomed(x, w, iters=16, eps=1e-8):
+    """Closed-form Weiszfeld: same fixed iteration count, weighted mean
+    start, eps-floored distances — mirrors GEOMED_ITERS exactly."""
+    act = (w > 0) & np.isfinite(x).all(axis=1)
+    wg = np.where(act, w, 0.0)
+    safe = np.where(act[:, None], x, 0.0)
+    den0 = wg.sum()
+    v = (safe * wg[:, None]).sum(axis=0) / (den0 if den0 > 0 else 1.0)
+    for _ in range(iters):
+        r = np.sqrt(((safe - v[None, :]) ** 2).sum(axis=1))
+        inv = wg / np.maximum(r, eps)
+        den = inv.sum()
+        v = (safe * inv[:, None]).sum(axis=0) / (den if den > 0 else 1.0)
+    return v
 
 
 class TestRobustMean:
@@ -249,7 +365,75 @@ class TestRobustMean:
         # and the attacker's pull really is bounded
         assert np.linalg.norm(got) < np.linalg.norm(x.mean(axis=0))
 
-    @pytest.mark.parametrize("kind", ["trim", "median", "clip"])
+    def test_krum_matches_numpy(self):
+        got = _run_robust(self.x, self.w, kind="krum", trim_frac=0.25)
+        want = _ref_krum(self.x, self.w, 0.25)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_krum_zero_frac_selects_all(self):
+        # f = 0: multi-Krum keeps every active row -> plain mean
+        got = _run_robust(self.x, self.w, kind="krum", trim_frac=0.0)
+        np.testing.assert_allclose(got, self.x.mean(axis=0), rtol=1e-5)
+
+    def test_krum_weighted_and_masked(self):
+        w = np.asarray([2, 1, 1, 0, 1, 1, 3, 1], np.float32)
+        got = _run_robust(self.x, w, kind="krum", trim_frac=0.25)
+        want = _ref_krum(self.x, w, 0.25)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_krum_excludes_colluding_pair(self):
+        x = self.x.copy()
+        x[0] = x[1] = 100.0 * self.x[:2].mean(axis=0)   # coordinated copies
+        got = _run_robust(x, self.w, kind="krum", trim_frac=0.4)
+        honest = self.x[2:].mean(axis=0)
+        assert np.linalg.norm(got - honest) < 1.0
+        np.testing.assert_allclose(got, _ref_krum(x, self.w, 0.4),
+                                   rtol=1e-5)
+
+    def test_geomed_matches_weiszfeld_reference(self):
+        got = _run_robust(self.x, self.w, kind="geomed")
+        np.testing.assert_allclose(got, _ref_geomed(self.x, self.w),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_geomed_weighted_and_masked(self):
+        w = np.asarray([2, 1, 1, 0, 1, 1, 3, 1], np.float32)
+        got = _run_robust(self.x, w, kind="geomed")
+        np.testing.assert_allclose(got, _ref_geomed(self.x, w),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_geomed_resists_colluding_pair(self):
+        x = self.x.copy()
+        x[0] = x[1] = 100.0 * self.x[:2].mean(axis=0)
+        got = _run_robust(x, self.w, kind="geomed")
+        honest = self.x[2:].mean(axis=0)
+        # the pair drags the plain mean far away; the geometric median
+        # stays inside the honest cluster
+        assert np.linalg.norm(got - honest) < 2.0
+        assert np.linalg.norm(x.mean(axis=0) - honest) > 10.0
+
+    def test_colluding_pair_degrades_trim_median_not_krum_geomed(self):
+        """2-of-8 coordinated copies (the collude fault mode's wire
+        pattern): the attack-induced shift — same estimator with and
+        without the attack — is catastrophic for trim (one copy survives
+        every t=1 coordinate window), a visible rank-displacement bias
+        for median, and negligible for the selection/geometric
+        estimators the attack cannot out-vote."""
+        rng = np.random.default_rng(3)
+        base = rng.normal(size=(8, 5)).astype(np.float32)
+        x = base.copy()
+        x[0] = x[1] = 100.0 * base[:2].mean(axis=0)
+        tf = {"trim": 0.2, "median": 0.1, "krum": 0.4, "geomed": 0.1}
+        shift = {
+            k: np.linalg.norm(
+                _run_robust(x, self.w, kind=k, trim_frac=tf[k])
+                - _run_robust(base, self.w, kind=k, trim_frac=tf[k]))
+            for k in tf}
+        assert shift["krum"] < 0.05 and shift["geomed"] < 0.5
+        assert shift["trim"] > 20.0
+        assert shift["median"] > 2.0 * shift["geomed"]
+
+    @pytest.mark.parametrize("kind", ["trim", "median", "clip", "krum",
+                                      "geomed"])
     def test_nonfinite_rows_never_leak(self, kind):
         x = self.x.copy()
         x[2] = np.nan
@@ -259,6 +443,12 @@ class TestRobustMean:
         if kind == "median":                   # exact: median of the 6 honest
             want = np.median(x[[0, 1, 3, 4, 5, 7]], axis=0)
             np.testing.assert_allclose(got, want, rtol=1e-5)
+        elif kind == "krum":
+            np.testing.assert_allclose(got, _ref_krum(x, self.w, 0.1),
+                                       rtol=1e-5)
+        elif kind == "geomed":
+            np.testing.assert_allclose(got, _ref_geomed(x, self.w),
+                                       rtol=1e-4, atol=1e-6)
 
     def test_trim_defeats_one_byzantine_scaler(self):
         x = self.x.copy()
@@ -272,7 +462,7 @@ class TestRobustMean:
 
     def test_all_rejected_returns_zero(self):
         x = np.full((8, 5), np.nan, np.float32)
-        for kind in ("trim", "median", "clip"):
+        for kind in ("trim", "median", "clip", "krum", "geomed"):
             got = _run_robust(x, self.w, kind=kind)
             np.testing.assert_array_equal(got, np.zeros(5, np.float32))
 
@@ -284,6 +474,16 @@ class TestRobustMean:
             make_robust_mean("trim", trim_frac=0.5)
         with pytest.raises(ValueError):
             make_robust_mean("clip", clip_mult=0.0)
+
+    def test_unknown_kind_error_lists_every_choice(self):
+        # the message is derived from ROBUST_AGG_CHOICES, so the two new
+        # estimators must appear in both the factory and the kernel error
+        for raiser in (lambda: make_robust_mean("bogus"),
+                       lambda: robust_federated_mean(
+                           jnp.zeros((4, 3)), jnp.ones(4), kind="bogus")):
+            with pytest.raises(ValueError) as ei:
+                raiser()
+            assert "krum" in str(ei.value) and "geomed" in str(ei.value)
 
 
 # ---------------------------------------------------------------------------
@@ -493,6 +693,51 @@ class TestAdversarialConvergence:
         assert not np.isfinite(loss) or loss > 1.5 * clean_loss
 
 
+class TestColludingAsyncAdversary:
+    """ISSUE 6 acceptance: under a seeded 2-of-8 colluding scale attack
+    with ``delay=`` stragglers active (``--async-rounds`` buffered
+    aggregation, staleness-weighted mixing), krum/geomed converge within
+    5% of the clean async baseline while the plain mean diverges — and
+    trim (t=1 < 2 colluders) visibly degrades, which is exactly why the
+    selection/geometric estimators exist."""
+
+    DELAY = "delay=0.3,delay_max=2,seed=11"
+    ATTACK = "corrupt=1,clients=0+1,mode=collude,scale=100," + DELAY
+
+    def _final_loss(self, data8, **kw):
+        cfg = FederatedConfig(K=8, Nloop=1, Nepoch=2, Nadmm=4,
+                              default_batch=16, check_results=False,
+                              admm_rho0=0.1, async_rounds=True,
+                              max_staleness=4, **kw)
+        _, (_, hist) = run_trainer(cfg, data8)
+        return hist[-1]["loss"]
+
+    @pytest.fixture(scope="class")
+    def clean_async_loss(self, data8):
+        return self._final_loss(data8, fault_spec=self.DELAY)
+
+    @pytest.mark.asyncfl
+    @pytest.mark.parametrize("agg,frac", [("krum", 0.4), ("geomed", 0.1)])
+    def test_krum_geomed_track_clean_baseline(self, data8,
+                                              clean_async_loss, agg, frac):
+        loss = self._final_loss(data8, fault_spec=self.ATTACK,
+                                robust_agg=agg, trim_frac=frac)
+        assert np.isfinite(loss)
+        assert abs(loss - clean_async_loss) / clean_async_loss < 0.05
+
+    @pytest.mark.asyncfl
+    def test_plain_mean_diverges(self, data8, clean_async_loss):
+        loss = self._final_loss(data8, fault_spec=self.ATTACK)
+        assert not np.isfinite(loss) or loss > 1.5 * clean_async_loss
+
+    @pytest.mark.asyncfl
+    def test_trim_degrades_under_collusion(self, data8, clean_async_loss):
+        # one coordinated copy survives every trimmed coordinate window
+        loss = self._final_loss(data8, fault_spec=self.ATTACK,
+                                robust_agg="trim", trim_frac=0.2)
+        assert not np.isfinite(loss) or loss > 1.5 * clean_async_loss
+
+
 # ---------------------------------------------------------------------------
 # construction-time validation
 # ---------------------------------------------------------------------------
@@ -506,6 +751,20 @@ class TestValidation:
         with pytest.raises(ValueError, match="robust"):
             BlockwiseFederatedTrainer(TinyNet(), small_cfg(robust_agg="avg"),
                                       data, FedAvg())
+
+    def test_bad_async_knobs(self, data):
+        with pytest.raises(ValueError, match="max_staleness"):
+            BlockwiseFederatedTrainer(
+                TinyNet(), small_cfg(async_rounds=True, max_staleness=-1),
+                data, FedAvg())
+        with pytest.raises(ValueError, match="staleness_alpha"):
+            BlockwiseFederatedTrainer(
+                TinyNet(), small_cfg(async_rounds=True,
+                                     staleness_alpha=-0.5), data, FedAvg())
+        with pytest.raises(ValueError, match="bb_update"):
+            BlockwiseFederatedTrainer(
+                TinyNet(), small_cfg(async_rounds=True, bb_update=True),
+                data, AdmmConsensus())
 
     def test_bad_guard_knobs(self, data):
         with pytest.raises(ValueError, match="quarantine_rounds"):

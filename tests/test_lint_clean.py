@@ -42,6 +42,41 @@ class TestGraftcheckClean:
 
         assert load_baseline(BASELINE) == set()
 
+    def test_jg107_engine_sites_resolve_and_pass(self):
+        """JG107 on engine.py is not vacuous: the arity checker must
+        actually resolve the shard bodies behind the engine's
+        ``shard_map(partial(fn, mode=...), ...)`` call sites (a resolver
+        regression would silently skip every site), and having resolved
+        them it must find nothing wrong."""
+        import ast
+
+        from federated_pytorch_test_tpu.analysis.core import ModuleContext
+        from federated_pytorch_test_tpu.analysis.rules import (
+            ShardingAnnotation,
+            _last_name,
+            _resolve_callable,
+            build_index,
+        )
+
+        path = (REPO / "federated_pytorch_test_tpu" / "train" / "engine.py")
+        src = path.read_text()
+        module = ModuleContext(path=str(path), source=src,
+                               tree=ast.parse(src))
+        index = build_index(module)
+        resolved = 0
+        for node in ast.walk(module.tree):
+            if (isinstance(node, ast.Call)
+                    and _last_name(node.func) == "shard_map" and node.args):
+                from federated_pytorch_test_tpu.analysis.rules import (
+                    _enclosing_scope,
+                )
+                scope = _enclosing_scope(index.parents, node)
+                fn, _, _ = _resolve_callable(node.args[0], scope,
+                                             index.parents, index.fn_by_scope)
+                resolved += fn is not None
+        assert resolved >= 4, "shard_map body resolver regressed"
+        assert list(ShardingAnnotation().check(module)) == []
+
     def test_jg106_is_warning_and_tree_has_none(self):
         """JG106 (donation) was promoted from advice to WARNING once the
         engines went donation-safe end to end (init_state deep-copies

@@ -25,6 +25,7 @@ CASES = [
     ("jg104_timer_no_sync.py", "JG104"),
     ("jg105_recompile_hazard.py", "JG105"),
     ("jg106_missing_donation.py", "JG106"),
+    ("jg107_sharding_annotation.py", "JG107"),
 ]
 
 

@@ -373,6 +373,74 @@ class TestCorruptSlotFallback:
                         resume=True, log=lambda m: None)
 
 
+class TestAsyncRunResume:
+    """--async-rounds kill/resume (ISSUE 6): the staleness ledger
+    (arrival round, birth round, cumulative rejections) rides in the
+    checkpoint meta and the frozen per-client params ARE the in-flight
+    buffer, so a resumed async run must replay the uninterrupted
+    trajectory exactly — through both checkpoint writers."""
+
+    ASYNC_CFG = dict(Nadmm=4, async_rounds=True, max_staleness=2,
+                     fault_spec="delay=0.5,delay_max=2,seed=9")
+    LEDGER_FIELDS = ("async_arrived", "admission_rejected", "buffer_depth",
+                     "n_active")
+
+    @pytest.mark.asyncfl
+    @pytest.mark.parametrize("async_ckpt", [False, True],
+                             ids=["sync", "async"])
+    def test_async_run_resumes_identically(self, data, tmp_path,
+                                           async_ckpt):
+        cfg = small_cfg(async_checkpoint=async_ckpt, **self.ASYNC_CFG)
+        ck = str(tmp_path / "ck")
+        _, hist_full = run_trainer(cfg, data)
+        # the kill point must leave updates in flight, or the ledger
+        # restore proves nothing
+        assert hist_full[1]["buffer_depth"] > 0
+
+        def bomb(state, rec):
+            if rec["nadmm"] == 1:
+                raise Killed
+
+        with pytest.raises(Killed):
+            run_trainer(cfg, data, checkpoint_path=ck, on_round=bomb)
+        _, hist_r = run_trainer(cfg, data, checkpoint_path=ck, resume=True)
+        assert len(hist_r) == len(hist_full)
+        for a, b in zip(hist_r, hist_full):
+            sa, sb = strip(a), strip(b)
+            assert sa.keys() == sb.keys()
+            # the ledger-derived counters are bit-identical by contract
+            for k in self.LEDGER_FIELDS:
+                assert sa[k] == sb[k], k
+            assert a["staleness_hist"] == b["staleness_hist"]
+            for k in sa:
+                np.testing.assert_allclose(sa[k], sb[k], rtol=1e-5,
+                                           err_msg=f"history field {k}")
+
+    @pytest.mark.asyncfl
+    def test_async_block_boundary_resume(self, data, tmp_path):
+        # a block rollover voids the in-flight buffer (block variables
+        # change identity); a kill exactly there must resume onto the
+        # fresh-ledger path and still match the uninterrupted run
+        cfg = small_cfg(Nadmm=2, async_rounds=True, max_staleness=2,
+                        fault_spec="delay=0.5,delay_max=2,seed=9")
+        ck = str(tmp_path / "ck")
+        _, hist_full = run_trainer(cfg, data, L=2)
+
+        def bomb(state, rec):
+            if rec["block"] == 0:
+                raise Killed
+
+        with pytest.raises(Killed):
+            run_trainer(cfg, data, L=2, checkpoint_path=ck, on_round=bomb)
+        _, hist_r = run_trainer(cfg, data, L=2, checkpoint_path=ck,
+                                resume=True)
+        assert [h["block"] for h in hist_r] == \
+            [h["block"] for h in hist_full]
+        for a, b in zip(hist_r, hist_full):
+            np.testing.assert_allclose(a["loss"], b["loss"], rtol=1e-5)
+            assert a["buffer_depth"] == b["buffer_depth"]
+
+
 class TestFaultyRunResume:
     """Fault schedule + guard/quarantine state across a kill/resume: the
     continued run must replay the interrupted trajectory bit-for-bit —
