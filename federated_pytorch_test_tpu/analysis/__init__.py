@@ -1,11 +1,14 @@
 """graftcheck — JAX-aware static analysis + runtime sanitizers.
 
-Static side (``core.py`` + ``rules.py`` + ``lint.py``): an AST lint
-engine with rules targeting the trace-time failure classes that have
-actually bitten this codebase — host syncs inside jitted round loops,
-wall-clock timers around async-dispatched computations, PRNG key reuse,
-Python control flow on traced values, recompilation hazards, and
-missing buffer donation.  Run it as::
+Static side (``core.py`` + ``rules.py`` + ``flow.py`` + ``lint.py``):
+an AST lint engine with rules targeting the trace-time failure classes
+that have actually bitten this codebase — host syncs inside jitted
+round loops, wall-clock timers around async-dispatched computations,
+PRNG key reuse, Python control flow on traced values, recompilation
+hazards, and missing buffer donation — plus the interprocedural layer
+in ``flow.py``: a whole-program call graph that chases traced values,
+donation facts, and PRNG key lineage across function boundaries
+(JG108-JG111).  Run it as::
 
     python -m federated_pytorch_test_tpu.analysis.lint \
         federated_pytorch_test_tpu bench.py
@@ -20,7 +23,9 @@ from .core import (  # noqa: F401
     Severity,
     Finding,
     Rule,
+    ProgramRule,
     LintEngine,
     load_baseline,
     save_baseline,
 )
+from .flow import ALL_RULES  # noqa: F401

@@ -8,11 +8,18 @@ Design:
   not import (and on fixture snippets that would crash at runtime).
 - Per-line suppression: ``# graftlint: disable=JG101`` (comma list, or
   ``all``) on the flagged line silences the finding.
+- A :class:`ProgramRule` inspects the *whole program* at once (every
+  module handed to one lint run, plus cached summaries of unchanged
+  modules in ``--changed`` mode) and may anchor a finding in any of
+  the live modules.  The interprocedural rules in ``flow.py`` are
+  program rules.
 - Baseline: a committed JSON file of finding *fingerprints* —
-  ``sha1(path :: rule :: stripped source line)`` — so grandfathered
-  findings survive line drift but resurface when the line changes.
-  The shipped baseline is empty: every finding of the shipped rules
-  was fixed, not baselined.
+  ``sha1(normalized path :: rule :: stripped source line :: chain)``
+  — so grandfathered findings survive line drift but resurface when
+  the line changes.  Paths are normalized to posix form relative to
+  the working directory, so fingerprints are stable across checkouts
+  and across the files a call chain spans.  The shipped baseline is
+  empty: every finding of the shipped rules was fixed, not baselined.
 - Exit policy: findings at or above the ``fail_on`` severity
   (default WARNING) that are neither suppressed nor baselined fail the
   run.  ADVICE findings report but never fail at the default level.
@@ -27,7 +34,8 @@ import json
 import re
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+from typing import (Dict, Iterable, Iterator, List, Optional, Sequence, Set,
+                    Tuple)
 
 
 class Severity(enum.IntEnum):
@@ -47,6 +55,19 @@ class Severity(enum.IntEnum):
                 f"{[s.name.lower() for s in cls]}") from None
 
 
+def norm_path(path: str) -> str:
+    """Posix path relative to the working directory when it is under it
+    (absolute and relative spellings of the same file fingerprint
+    identically; checkouts rooted elsewhere still agree with each
+    other)."""
+    pp = Path(path)
+    try:
+        pp = pp.resolve().relative_to(Path.cwd().resolve())
+    except (ValueError, OSError):
+        pass
+    return pp.as_posix()
+
+
 @dataclass(frozen=True)
 class Finding:
     path: str                 # as given on the command line (relative ok)
@@ -56,28 +77,37 @@ class Finding:
     severity: Severity
     message: str
     source_line: str = ""     # stripped text of the flagged line
+    call_chain: Tuple[str, ...] = ()   # interprocedural path, outermost first
 
     def fingerprint(self) -> str:
         """Stable id for baselining: survives line-number drift, breaks
-        when the flagged line's content changes."""
-        key = f"{self.path}::{self.rule_id}::{self.source_line}"
+        when the flagged line's content changes.  The call chain is part
+        of the identity — two hazards reached through different chains
+        are different findings even when anchored on the same line."""
+        key = f"{norm_path(self.path)}::{self.rule_id}::{self.source_line}"
+        if self.call_chain:
+            key += "::" + " -> ".join(self.call_chain)
         return hashlib.sha1(key.encode("utf-8")).hexdigest()
 
     def to_json(self) -> Dict[str, object]:
         return {
-            "path": self.path,
+            "path": norm_path(self.path),
             "line": self.line,
             "col": self.col,
             "rule": self.rule_id,
             "severity": self.severity.name.lower(),
             "message": self.message,
+            "source_line": self.source_line,
+            "call_chain": list(self.call_chain),
             "fingerprint": self.fingerprint(),
         }
 
     def render(self) -> str:
+        chain = (f"  [via {' -> '.join(self.call_chain)}]"
+                 if self.call_chain else "")
         return (f"{self.path}:{self.line}:{self.col + 1}: "
                 f"[{self.rule_id} {self.severity.name.lower()}] "
-                f"{self.message}")
+                f"{self.message}{chain}")
 
 
 @dataclass
@@ -111,13 +141,36 @@ class Rule:
         raise NotImplementedError
 
     def finding(self, module: ModuleContext, node: ast.AST,
-                message: str) -> Finding:
+                message: str,
+                call_chain: Sequence[str] = ()) -> Finding:
         line = getattr(node, "lineno", 1)
         col = getattr(node, "col_offset", 0)
         return Finding(path=module.path, line=line, col=col,
                        rule_id=self.id, severity=self.severity,
                        message=message,
-                       source_line=module.line_text(line))
+                       source_line=module.line_text(line),
+                       call_chain=tuple(call_chain))
+
+
+class ProgramRule(Rule):
+    """A rule that sees the whole program at once.
+
+    ``check_program`` receives every live :class:`ModuleContext` of the
+    lint run plus ``extra_summaries`` — pre-extracted, JSON-shaped
+    module summaries standing in for files that were *not* re-parsed
+    (the ``--changed`` fast path).  Findings must anchor in one of the
+    live modules; the engine drops any finding anchored elsewhere.
+    ``state`` is a per-run scratch dict shared by all program rules so
+    expensive artifacts (the call graph) are built once.
+    """
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        return iter(())
+
+    def check_program(self, modules: Sequence[ModuleContext],
+                      extra_summaries: Sequence[dict],
+                      state: dict) -> Iterator[Finding]:
+        raise NotImplementedError
 
 
 # --------------------------------------------------------------- suppression
@@ -182,14 +235,17 @@ class LintResult:
 
 class LintEngine:
     """Runs a rule set over files/trees and applies the filtering
-    pipeline (syntax -> rules -> suppressions -> baseline)."""
+    pipeline (syntax -> module rules -> program rules -> suppressions
+    -> baseline)."""
 
     def __init__(self, rules: Sequence[Rule],
                  baseline: Optional[Set[str]] = None):
-        self.rules = list(rules)
+        self.rules = [r for r in rules if not isinstance(r, ProgramRule)]
+        self.program_rules = [r for r in rules if isinstance(r, ProgramRule)]
         self.baseline = baseline or set()
 
-    def lint_source(self, source: str, path: str) -> LintResult:
+    def _parse(self, source: str, path: str
+               ) -> Tuple[Optional[ModuleContext], Optional[Finding]]:
         try:
             tree = ast.parse(source, filename=path)
         except SyntaxError as exc:
@@ -198,35 +254,62 @@ class LintEngine:
                         severity=Severity.ERROR,
                         message=f"syntax error: {exc.msg}",
                         source_line="")
-            return LintResult(findings=[f])
-        module = ModuleContext(path=path, source=source, tree=tree)
-        suppressions = suppressed_rules_by_line(source)
+            return None, f
+        return ModuleContext(path=path, source=source, tree=tree), None
+
+    def lint_modules(self, modules: Sequence[ModuleContext],
+                     extra_summaries: Sequence[dict] = ()) -> LintResult:
+        """The full pipeline over already-parsed modules.  Program
+        rules see ``modules + extra_summaries`` but may only anchor
+        findings inside ``modules`` (the live set); anything anchored
+        in a summary-only file is dropped — a full run owns those."""
+        supp = {m.path: suppressed_rules_by_line(m.source) for m in modules}
+        live = set(supp)
+        raw: List[Finding] = []
+        for module in modules:
+            for rule in self.rules:
+                raw.extend(rule.check(module))
+        state: dict = {}
+        for rule in self.program_rules:
+            raw.extend(f for f in rule.check_program(modules,
+                                                     extra_summaries, state)
+                       if f.path in live)
         kept: List[Finding] = []
         n_sup = n_base = 0
-        for rule in self.rules:
-            for finding in rule.check(module):
-                if is_suppressed(finding, suppressions):
-                    n_sup += 1
-                elif finding.fingerprint() in self.baseline:
-                    n_base += 1
-                else:
-                    kept.append(finding)
+        for finding in raw:
+            if is_suppressed(finding, supp.get(finding.path, {})):
+                n_sup += 1
+            elif finding.fingerprint() in self.baseline:
+                n_base += 1
+            else:
+                kept.append(finding)
         kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
         return LintResult(findings=kept, suppressed=n_sup, baselined=n_base)
+
+    def lint_source(self, source: str, path: str) -> LintResult:
+        module, syntax = self._parse(source, path)
+        if module is None:
+            return LintResult(findings=[syntax])
+        return self.lint_modules([module])
 
     def lint_file(self, path: Path) -> LintResult:
         return self.lint_source(Path(path).read_text(), str(path))
 
-    def lint_paths(self, paths: Sequence[str]) -> LintResult:
-        findings: List[Finding] = []
-        n_sup = n_base = 0
+    def lint_paths(self, paths: Sequence[str],
+                   extra_summaries: Sequence[dict] = ()) -> LintResult:
+        modules: List[ModuleContext] = []
+        syntax: List[Finding] = []
         for p in sorted(expand_paths(paths)):
-            res = self.lint_file(p)
-            findings.extend(res.findings)
-            n_sup += res.suppressed
-            n_base += res.baselined
-        return LintResult(findings=findings, suppressed=n_sup,
-                          baselined=n_base)
+            module, err = self._parse(Path(p).read_text(), str(p))
+            if module is None:
+                syntax.append(err)
+            else:
+                modules.append(module)
+        result = self.lint_modules(modules, extra_summaries)
+        result.findings.extend(syntax)
+        result.findings.sort(
+            key=lambda f: (f.path, f.line, f.col, f.rule_id))
+        return result
 
 
 def expand_paths(paths: Sequence[str]) -> List[Path]:
@@ -253,11 +336,73 @@ def render_text(result: LintResult, fail_on: Severity) -> str:
     return "\n".join(lines)
 
 
+#: JSON output schema version; bumped only on breaking changes (new
+#: finding fields are additive and do not bump it).
+JSON_SCHEMA_VERSION = 2
+
+
 def render_json(result: LintResult, fail_on: Severity) -> str:
     return json.dumps({
+        "schema_version": JSON_SCHEMA_VERSION,
         "findings": [f.to_json() for f in result.findings],
         "suppressed": result.suppressed,
         "baselined": result.baselined,
         "failing": len(result.failing(fail_on)),
         "fail_on": fail_on.name.lower(),
     }, indent=2)
+
+
+_SARIF_LEVELS = {Severity.ADVICE: "note", Severity.WARNING: "warning",
+                 Severity.ERROR: "error"}
+
+
+def render_sarif(result: LintResult, rules: Sequence[Rule]) -> str:
+    """SARIF 2.1.0 so findings render inline in code-review UIs."""
+    rule_meta = {}
+    for r in rules:
+        rule_meta.setdefault(r.id, {
+            "id": r.id,
+            "shortDescription": {"text": r.summary or r.id},
+            "defaultConfiguration": {"level": _SARIF_LEVELS[r.severity]},
+        })
+    results = []
+    for f in result.findings:
+        rule_meta.setdefault(f.rule_id, {
+            "id": f.rule_id,
+            "shortDescription": {"text": f.rule_id},
+            "defaultConfiguration": {"level": _SARIF_LEVELS[f.severity]},
+        })
+        msg = f.message
+        if f.call_chain:
+            msg += f" [via {' -> '.join(f.call_chain)}]"
+        results.append({
+            "ruleId": f.rule_id,
+            "level": _SARIF_LEVELS[f.severity],
+            "message": {"text": msg},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": norm_path(f.path)},
+                    "region": {"startLine": f.line,
+                               "startColumn": f.col + 1},
+                },
+            }],
+            "partialFingerprints": {
+                "graftcheckFingerprint/v1": f.fingerprint(),
+            },
+        })
+    doc = {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftcheck",
+                "informationUri": (
+                    "federated_pytorch_test_tpu/analysis/README"),
+                "rules": sorted(rule_meta.values(),
+                                key=lambda r: r["id"]),
+            }},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2)

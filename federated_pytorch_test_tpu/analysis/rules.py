@@ -11,9 +11,12 @@ static there".  Jit contexts are found syntactically:
 - ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorators;
 - functions lexically nested inside either of the above.
 
-Cross-function traced-value flow (a traced array passed into a helper
-defined elsewhere) is out of scope for this pass — see the ROADMAP
-open item.
+The rules in this module are *lexical*: each looks at one jit context
+at a time.  Cross-function flow — a traced array passed into a helper
+defined elsewhere, a donated buffer read after the donating call, a
+PRNG key consumed on both sides of a function boundary — lives in
+:mod:`.flow` (JG108-JG111), which reuses this module's
+:class:`JitIndex` and callable-resolution helpers.
 """
 
 from __future__ import annotations
@@ -95,6 +98,7 @@ class JitSite:
     static_params: Set[str] = field(default_factory=set)
     donates: bool = False
     static_argnums: Tuple[int, ...] = ()
+    donate_argnums_vals: Tuple[int, ...] = ()  # literal ints when spelled
     bound_name: Optional[str] = None  # `f = jax.jit(...)` binding, if any
 
 
@@ -105,6 +109,7 @@ class JitIndex:
     contexts: Set[ast.AST]                       # FunctionDefs under jit
     static_by_fn: Dict[ast.AST, Set[str]]        # root fn -> static params
     numpy_aliases: Set[str]
+    jnp_aliases: Set[str]
     jitted_bindings: Dict[str, JitSite]
     fn_by_scope: Dict[Tuple[ast.AST, str], ast.AST]
 
@@ -179,6 +184,21 @@ def _const_tuple_ints(node: ast.AST) -> Tuple[int, ...]:
     return ()
 
 
+def _donate_ints(node: ast.AST) -> Tuple[int, ...]:
+    """Literal donate_argnums, seeing through the engines' conditional
+    wrapper ``donate_argnums=self._donate_argnums((0, 1))`` (donation
+    still *happens* at those positions whenever the knob is on, so the
+    flow rules must treat the site as donating)."""
+    vals = _const_tuple_ints(node)
+    if vals:
+        return vals
+    if isinstance(node, ast.Call) and len(node.args) == 1:
+        return _const_tuple_ints(node.args[0])
+    if isinstance(node, ast.IfExp):
+        return _donate_ints(node.body) or _donate_ints(node.orelse)
+    return ()
+
+
 def _const_strs(node: ast.AST) -> Tuple[str, ...]:
     if isinstance(node, ast.Constant) and isinstance(node.value, str):
         return (node.value,)
@@ -235,6 +255,7 @@ def build_index(module: ModuleContext) -> JitIndex:
             static = set(static_kw)
             argnums: Tuple[int, ...] = ()
             donates = False
+            donate_vals: Tuple[int, ...] = ()
             for kw in node.keywords:
                 if kw.arg in ("static_argnums", "static_argnposnums"):
                     argnums = _const_tuple_ints(kw.value)
@@ -242,6 +263,8 @@ def build_index(module: ModuleContext) -> JitIndex:
                     static |= set(_const_strs(kw.value))
                 elif kw.arg in ("donate_argnums", "donate_argnames"):
                     donates = True
+                    if kw.arg == "donate_argnums":
+                        donate_vals = _donate_ints(kw.value)
             if fn is not None:
                 names = _fn_param_names(fn)
                 for i in argnums:
@@ -249,7 +272,8 @@ def build_index(module: ModuleContext) -> JitIndex:
                         static.add(names[i])
             site = JitSite(call=node, node=node, fn=fn,
                            static_params=static, donates=donates,
-                           static_argnums=argnums)
+                           static_argnums=argnums,
+                           donate_argnums_vals=donate_vals)
             sites.append(site)
             parent = parents.get(node)
             if (isinstance(parent, ast.Assign) and len(parent.targets) == 1
@@ -265,6 +289,7 @@ def build_index(module: ModuleContext) -> JitIndex:
             static: Set[str] = set()
             argnums = ()
             donates = False
+            donate_vals: Tuple[int, ...] = ()
             is_jit = False
             if _dotted(dec) in ("jit", "jax.jit"):
                 is_jit = True
@@ -282,6 +307,8 @@ def build_index(module: ModuleContext) -> JitIndex:
                             static |= set(_const_strs(kw.value))
                         elif kw.arg in ("donate_argnums", "donate_argnames"):
                             donates = True
+                            if kw.arg == "donate_argnums":
+                                donate_vals = _donate_ints(kw.value)
             if is_jit:
                 names = _fn_param_names(node)
                 for i in argnums:
@@ -290,7 +317,8 @@ def build_index(module: ModuleContext) -> JitIndex:
                 sites.append(JitSite(
                     call=dec if isinstance(dec, ast.Call) else None,
                     node=dec, fn=node, static_params=static,
-                    donates=donates, static_argnums=argnums))
+                    donates=donates, static_argnums=argnums,
+                    donate_argnums_vals=donate_vals))
 
     roots: Dict[ast.AST, Set[str]] = {}
     for site in sites:
@@ -307,6 +335,7 @@ def build_index(module: ModuleContext) -> JitIndex:
     index = JitIndex(parents=parents, sites=sites, contexts=contexts,
                      static_by_fn=roots, numpy_aliases=numpy_aliases or
                      {"numpy", "np", "onp"},
+                     jnp_aliases=jnp_aliases or {"jnp"},
                      jitted_bindings=jitted_bindings,
                      fn_by_scope=fn_by_scope)
     module._graft_index = index
@@ -898,7 +927,9 @@ class ShardingAnnotation(Rule):
                     "undefined axis")
 
 
-ALL_RULES: Sequence[Rule] = (
+#: the lexical (single-module) rule set; :mod:`.flow` appends the
+#: interprocedural JG108-JG111 rules and exposes the combined ALL_RULES
+MODULE_RULES: Sequence[Rule] = (
     HostSyncInJit(),
     TracedBranch(),
     KeyReuse(),

@@ -31,29 +31,47 @@ from jax.experimental import checkify
 _errors_cache: "frozenset | None" = None
 
 
+def index_checks_supported(version: str) -> bool:
+    """Whether this jax version's ``index_checks`` are trustworthy.
+
+    Every 0.4.x ``checkify.scatter_oob`` crashes (internal IndexError,
+    not a check failure) on the scatter in a gather VJP — the exact op
+    the cross-entropy ``take_along_axis`` backward pass emits — so the
+    whole 0.4 line is gated off without probing.  0.5+ carries the fix;
+    an unparseable version string returns True so the runtime probe in
+    :func:`sanitize_errors` gets the final word.
+    """
+    try:
+        major, minor = (int(x) for x in version.split(".")[:2])
+    except (ValueError, TypeError):
+        return True
+    return (major, minor) >= (0, 5)
+
+
 def sanitize_errors():
     """NaN/inf checks always; index checks when this jax supports them.
 
-    jax 0.4.x's ``checkify.scatter_oob`` crashes (internal IndexError,
-    not a check failure) on the scatter in a gather VJP — the exact op
-    the cross-entropy ``take_along_axis`` backward pass emits — so
-    index_checks are probed once on a tiny gather-grad and dropped if
-    the instrumentation itself is broken.  Cached after the first call.
+    The version gate (:func:`index_checks_supported`) rejects the 0.4.x
+    line outright; newer jax is still probed once on a tiny gather-grad
+    and index_checks dropped if the instrumentation itself is broken.
+    Cached after the first call, so a jax bump flips index checks on
+    with no code change here.
     """
     global _errors_cache
     if _errors_cache is None:
         errs = checkify.float_checks
-        try:
-            def _probe(x, i):
-                sel = jnp.take_along_axis(x, i[..., None], axis=-1)
-                return sel[..., 0].sum()
+        if index_checks_supported(jax.__version__):
+            try:
+                def _probe(x, i):
+                    sel = jnp.take_along_axis(x, i[..., None], axis=-1)
+                    return sel[..., 0].sum()
 
-            checkify.checkify(jax.grad(_probe),
-                              errors=checkify.index_checks)(
-                jnp.ones((2, 3)), jnp.arange(2))
-            errs = errs | checkify.index_checks
-        except Exception:
-            pass
+                checkify.checkify(jax.grad(_probe),
+                                  errors=checkify.index_checks)(
+                    jnp.ones((2, 3)), jnp.arange(2))
+                errs = errs | checkify.index_checks
+            except Exception:
+                pass
         _errors_cache = errs
     return _errors_cache
 

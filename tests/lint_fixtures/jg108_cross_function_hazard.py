@@ -1,0 +1,18 @@
+"""Fixture: triggers exactly JG108 (host sync one call away from jit).
+
+The hazard lives in ``helper`` — lexically OUTSIDE any jit context, so
+JG101 stays quiet — and only the interprocedural pass sees that the
+jitted ``step`` hands its traced argument to it.
+"""
+import jax
+
+
+def helper(v):
+    return v.item()
+
+
+def step(x):
+    return helper(x)
+
+
+step_jit = jax.jit(step)

@@ -1,0 +1,19 @@
+"""Fixture: triggers exactly JG109 (buffer read after being donated).
+
+``update`` itself donates its first argument, so JG106 stays quiet; the
+bug is in the CALLER, which reads ``state`` again after the jitted call
+may already have aliased its buffer away.
+"""
+import jax
+
+
+def update(state, grad):
+    return state - 0.1 * grad
+
+
+update_jit = jax.jit(update, donate_argnums=(0,))
+
+
+def drive(state, grad):
+    new = update_jit(state, grad)
+    return new + state

@@ -1,0 +1,18 @@
+"""Fixture: triggers exactly JG110 (key consumed again across a call).
+
+``draw`` uses ``key`` locally only ONCE, so the lexical JG103 stays
+quiet — the second consumption happens inside ``sample``, visible only
+to the interprocedural lineage pass.
+"""
+import jax
+
+
+def sample(key):
+    return jax.random.normal(key, (4,))
+
+
+def draw():
+    key = jax.random.PRNGKey(0)
+    a = sample(key)
+    b = jax.random.uniform(key, (4,))
+    return a + b

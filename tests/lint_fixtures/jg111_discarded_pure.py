@@ -1,0 +1,7 @@
+"""Fixture: triggers exactly JG111 (discarded pure jax op result)."""
+import jax.numpy as jnp
+
+
+def update_row(x, v):
+    x.at[0].set(v)
+    return x

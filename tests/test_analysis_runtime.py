@@ -88,6 +88,38 @@ class TestSanitizeUnits:
         assert checkify.float_checks <= errs
         assert sanitize_errors() is errs        # probed once, cached
 
+    def test_index_checks_version_gate(self):
+        """The 0.4.x line is rejected without probing (its scatter_oob
+        crashes on gather-VJP scatters); 0.5+ is eligible, and an
+        unparseable version falls through to the runtime probe.  A jax
+        bump past 0.5 flips index checks on with no code change."""
+        from federated_pytorch_test_tpu.analysis.sanitize import (
+            index_checks_supported,
+        )
+
+        assert not index_checks_supported("0.4.37")
+        assert not index_checks_supported("0.4.0")
+        assert index_checks_supported("0.5.0")
+        assert index_checks_supported("0.6.1")
+        assert index_checks_supported("1.0")
+        assert index_checks_supported("nightly-garbage")
+
+    def test_sanitize_errors_respects_gate_on_this_jax(self):
+        """Pin the probe behavior on the installed jax: when the version
+        gate rejects it, the error set is exactly float_checks (the
+        probe never runs); when it accepts, index_checks may join."""
+        from jax.experimental import checkify
+
+        from federated_pytorch_test_tpu.analysis.sanitize import (
+            index_checks_supported,
+        )
+
+        errs = sanitize_errors()
+        if not index_checks_supported(jax.__version__):
+            assert errs == checkify.float_checks
+        else:
+            assert checkify.float_checks <= errs
+
     def test_sentinel_counts_traces_and_retraces(self):
         s = TraceSentinel()
         f = jax.jit(s.wrap(lambda x: x * 2, "f"))

@@ -17,8 +17,8 @@ from pathlib import Path
 import pytest
 
 from federated_pytorch_test_tpu.analysis import LintEngine, Severity
+from federated_pytorch_test_tpu.analysis.flow import ALL_RULES
 from federated_pytorch_test_tpu.analysis.lint import main as lint_main
-from federated_pytorch_test_tpu.analysis.rules import ALL_RULES
 
 REPO = Path(__file__).resolve().parents[1]
 TARGETS = [str(REPO / "federated_pytorch_test_tpu"), str(REPO / "bench.py")]
@@ -76,6 +76,24 @@ class TestGraftcheckClean:
                 resolved += fn is not None
         assert resolved >= 4, "shard_map body resolver regressed"
         assert list(ShardingAnnotation().check(module)) == []
+
+    def test_changed_gate_exits_zero(self, tmp_path, capsys):
+        """The pre-commit path: ``--changed HEAD`` with a summary cache
+        over the shipped tree must agree with the full run (exit 0).
+        Running it twice also exercises the cache read path."""
+        cache = tmp_path / "graftcheck-cache.json"
+        for _ in range(2):
+            rc = lint_main(TARGETS + ["--changed", "HEAD",
+                                      "--cache", str(cache)])
+            assert rc == 0, capsys.readouterr().out
+            assert cache.exists()
+
+    def test_flow_rules_active_in_gate(self):
+        """The clean gate is not vacuous for the interprocedural layer:
+        ALL_RULES must include JG108-JG111 (so the assertions above ran
+        them over the tree)."""
+        ids = {r.id for r in ALL_RULES}
+        assert {"JG108", "JG109", "JG110", "JG111"} <= ids
 
     def test_jg106_is_warning_and_tree_has_none(self):
         """JG106 (donation) was promoted from advice to WARNING once the

@@ -1,0 +1,357 @@
+"""Interprocedural graftcheck (analysis/flow.py): resolver units,
+non-vacuity of JG108-JG111 vs their lexical siblings, cross-file
+baseline round-trips, machine-readable output, and the ``--changed``
+git-scoped mode.
+
+The non-vacuity pairs are the PR contract: the same hazard written
+across a function boundary fires ONLY the flow rule, written lexically
+it fires ONLY the old rule — proving the call-graph resolution does
+real work instead of re-deriving the lexical findings.
+"""
+
+import ast
+import json
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from federated_pytorch_test_tpu.analysis.core import (
+    LintEngine,
+    ModuleContext,
+)
+from federated_pytorch_test_tpu.analysis.flow import (
+    ALL_RULES,
+    Program,
+    extract_module_summary,
+)
+from federated_pytorch_test_tpu.analysis.lint import main as lint_main
+
+FIXTURES = Path(__file__).resolve().parent / "lint_fixtures"
+
+
+def _summary(src: str, path: str = "mod.py") -> dict:
+    return extract_module_summary(
+        ModuleContext(path=path, source=src, tree=ast.parse(src)))
+
+
+def _program(*named_sources) -> Program:
+    return Program([_summary(src, path) for path, src in named_sources])
+
+
+def _lint_sources(*named_sources):
+    engine = LintEngine(ALL_RULES)
+    modules = []
+    for path, src in named_sources:
+        module, err = engine._parse(src, path)
+        assert err is None, err
+        modules.append(module)
+    return engine.lint_modules(modules)
+
+
+class TestResolver:
+    def test_bare_name_resolves_to_module_function(self):
+        prog = _program(("m.py", "def f(a, b):\n    return a\n"
+                                 "def g(x):\n    return f(x, 1)\n"))
+        g = prog.fns[("m.py", "g")]
+        targets = prog.resolve(g, {"k": "dotted", "v": "f"})
+        assert [t.fn["qual"] for t in targets] == ["f"]
+        assert targets[0].param_for_pos(0) == "a"
+
+    def test_partial_alias_shifts_positions(self):
+        src = ("from functools import partial\n"
+               "def f(a, b, c):\n    return c\n"
+               "g = partial(f, 1)\n"
+               "def h(x):\n    return g(x, 2)\n")
+        prog = _program(("m.py", src))
+        h = prog.fns[("m.py", "h")]
+        targets = prog.resolve(h, {"k": "dotted", "v": "g"})
+        assert [t.fn["qual"] for t in targets] == ["f"]
+        # partial bound ``a``: h's positional 0 lands on ``b``
+        assert targets[0].param_for_pos(0) == "b"
+
+    def test_jit_wrapper_alias_is_transparent(self):
+        src = ("import jax\n"
+               "def step(state, lr):\n    return state\n"
+               "step_jit = jax.jit(step, static_argnums=(1,))\n"
+               "def drive(s):\n    return step_jit(s, 0.1)\n")
+        prog = _program(("m.py", src))
+        drive = prog.fns[("m.py", "drive")]
+        targets = prog.resolve(drive, {"k": "dotted", "v": "step_jit"})
+        assert [t.fn["qual"] for t in targets] == ["step"]
+        assert targets[0].param_for_pos(0) == "state"
+
+    def test_method_resolution_skips_self_and_walks_bases(self):
+        src = ("class Base:\n"
+               "    def shared(self, x):\n        return x\n"
+               "class Child(Base):\n"
+               "    def run(self, v):\n        return self.shared(v)\n")
+        prog = _program(("m.py", src))
+        run = prog.fns[("m.py", "Child.run")]
+        targets = prog.resolve(run, {"k": "dotted", "v": "self.shared"})
+        assert [t.fn["qual"] for t in targets] == ["Base.shared"]
+        assert targets[0].skip_self
+        assert targets[0].param_for_pos(0) == "x"
+
+    def test_untyped_method_call_unions_program_classes(self):
+        prog = _program(
+            ("a.py", "class Trainer:\n"
+                     "    def _build_fns(self, ci):\n        return ci\n"),
+            ("b.py", "def bench(trainer):\n"
+                     "    return trainer._build_fns(0)\n"))
+        bench = prog.fns[("b.py", "bench")]
+        targets = prog.resolve(bench,
+                               {"k": "dotted", "v": "trainer._build_fns"})
+        assert [t.fn["qual"] for t in targets] == ["Trainer._build_fns"]
+
+    def test_import_suffix_match_resolves_cross_module(self):
+        prog = _program(
+            ("pkg/util.py", "def helper(v):\n    return v\n"),
+            ("pkg/main.py", "from pkg import util\n"
+                            "def go(x):\n    return util.helper(x)\n"))
+        go = prog.fns[("pkg/main.py", "go")]
+        targets = prog.resolve(go, {"k": "dotted", "v": "util.helper"})
+        assert [t.fn["qual"] for t in targets] == ["helper"]
+
+    def test_external_callees_resolve_to_nothing(self):
+        prog = _program(("m.py", "import numpy as np\n"
+                                 "def f(x):\n    return np.sum(x)\n"))
+        f = prog.fns[("m.py", "f")]
+        assert prog.resolve(f, {"k": "dotted", "v": "np.sum"}) == []
+
+
+class TestNonVacuity:
+    """Cross-boundary hazard -> flow rule only; lexical hazard -> old
+    rule only.  Each pair shares the underlying defect."""
+
+    def _ids(self, result):
+        return {f.rule_id for f in result.findings}
+
+    def test_jg108_vs_jg101(self):
+        cross = (FIXTURES / "jg108_cross_function_hazard.py").read_text()
+        lexical = (FIXTURES / "jg101_host_sync.py").read_text()
+        assert self._ids(_lint_sources(("c.py", cross))) == {"JG108"}
+        assert self._ids(_lint_sources(("l.py", lexical))) == {"JG101"}
+
+    def test_jg109_vs_jg106(self):
+        cross = (FIXTURES / "jg109_use_after_donate.py").read_text()
+        lexical = (FIXTURES / "jg106_missing_donation.py").read_text()
+        assert self._ids(_lint_sources(("c.py", cross))) == {"JG109"}
+        assert self._ids(_lint_sources(("l.py", lexical))) == {"JG106"}
+
+    def test_jg110_vs_jg103(self):
+        cross = (FIXTURES / "jg110_key_lineage.py").read_text()
+        lexical = (FIXTURES / "jg103_key_reuse.py").read_text()
+        assert self._ids(_lint_sources(("c.py", cross))) == {"JG110"}
+        assert self._ids(_lint_sources(("l.py", lexical))) == {"JG103"}
+
+    def test_jg108_finding_prints_the_call_chain(self):
+        result = _lint_sources(
+            ("c.py",
+             (FIXTURES / "jg108_cross_function_hazard.py").read_text()))
+        (finding,) = result.findings
+        assert finding.call_chain == ("c.py:step", "c.py:helper")
+        assert "c.py:step -> c.py:helper" in finding.render()
+
+
+FACTORY_SRC = """\
+import jax
+from functools import partial
+
+
+class Trainer:
+    def _instrument_jit(self, fn, name, donate_argnums=()):
+        return jax.jit(fn, donate_argnums=donate_argnums)
+
+    def _donate_argnums(self, nums):
+        return nums
+
+    def _build_fns(self, ci):
+        def body(state, z):
+            return state, z
+        train_epoch = self._instrument_jit(
+            body, "t", donate_argnums=self._donate_argnums((0,)))
+        comm_fns = {}
+        for mode in ("plain", "bb"):
+            comm_fns[mode] = self._instrument_jit(
+                partial(body), mode,
+                donate_argnums=self._donate_argnums((0, 1)))
+        fns = (train_epoch, comm_fns)
+        return fns
+"""
+
+CALLER_BAD_SRC = """\
+def drive(trainer, state, z):
+    train_epoch, comm_fns = trainer._build_fns(0)
+    for _ in range(3):
+        out = comm_fns["plain"](state, z)
+    return out
+"""
+
+CALLER_GOOD_SRC = """\
+def drive(trainer, state, z):
+    train_epoch, comm_fns = trainer._build_fns(0)
+    for _ in range(3):
+        state, z = comm_fns["plain"](state, z)
+    return state, z
+"""
+
+
+class TestCrossFileDonation:
+    """JG109 through a factory in another file — the `_bench_round`
+    bug class: donation facts come from the ENGINE module's
+    ``comm_fns[mode] = instrument_jit(..., donate_argnums=...)`` and
+    the finding lands in the CALLER."""
+
+    def test_unrebound_loop_buffer_fires_in_caller_only(self):
+        result = _lint_sources(("engine_f.py", FACTORY_SRC),
+                               ("bench_f.py", CALLER_BAD_SRC))
+        jg109 = [f for f in result.findings if f.rule_id == "JG109"]
+        assert {f.path for f in jg109} == {"bench_f.py"}
+        assert {n for f in jg109 for n in ("state", "z")
+                if f"'{n}'" in f.message} == {"state", "z"}
+        assert all("engine_f.py:Trainer._build_fns" in f.call_chain
+                   for f in jg109)
+
+    def test_threaded_loop_state_is_quiet(self):
+        result = _lint_sources(("engine_f.py", FACTORY_SRC),
+                               ("bench_f.py", CALLER_GOOD_SRC))
+        assert [f for f in result.findings
+                if f.rule_id == "JG109"] == []
+
+    def test_baseline_round_trip_with_cross_file_findings(self, tmp_path):
+        """Fingerprints that include call chains survive a save/load
+        round trip AND anchor-file line drift."""
+        result = _lint_sources(("engine_f.py", FACTORY_SRC),
+                               ("bench_f.py", CALLER_BAD_SRC))
+        assert result.findings
+        fps = {f.fingerprint() for f in result.findings}
+        from federated_pytorch_test_tpu.analysis.core import (
+            load_baseline,
+            save_baseline,
+        )
+        bl = tmp_path / "bl.json"
+        save_baseline(bl, result.findings)
+        loaded = load_baseline(bl)
+        assert loaded == fps
+        engine = LintEngine(ALL_RULES, baseline=loaded)
+        drifted = "# leading comment\n" + CALLER_BAD_SRC
+        m1, _ = engine._parse(FACTORY_SRC, "engine_f.py")
+        m2, _ = engine._parse(drifted, "bench_f.py")
+        again = engine.lint_modules([m1, m2])
+        assert again.findings == []
+        # both loop findings anchor on one line -> one fingerprint
+        # grandfathers both (fingerprints are line-keyed by design)
+        assert again.baselined == len(result.findings)
+
+
+class TestMachineOutput:
+    def test_json_schema_has_call_chains(self, capsys):
+        rc = lint_main([str(FIXTURES / "jg108_cross_function_hazard.py"),
+                        "--json"])
+        assert rc == 1
+        data = json.loads(capsys.readouterr().out)
+        assert data["schema_version"] == 2
+        (finding,) = data["findings"]
+        assert finding["rule"] == "JG108"
+        assert len(finding["call_chain"]) == 2
+        assert finding["path"].endswith(
+            "lint_fixtures/jg108_cross_function_hazard.py")
+
+    def test_sarif_output_is_valid_and_carries_fingerprints(self, capsys):
+        rc = lint_main([str(FIXTURES / "jg109_use_after_donate.py"),
+                        "--sarif"])
+        assert rc == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        assert {"JG101", "JG108", "JG109", "JG110", "JG111"} <= rule_ids
+        (res,) = run["results"]
+        assert res["ruleId"] == "JG109"
+        assert res["level"] == "error"
+        assert res["partialFingerprints"]["graftcheckFingerprint/v1"]
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["region"]["startLine"] > 0
+
+    def test_json_and_sarif_are_mutually_exclusive(self, capsys):
+        rc = lint_main([str(FIXTURES), "--json", "--sarif"])
+        assert rc == 2
+        capsys.readouterr()
+
+
+def _git(cwd, *args):
+    subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                    *args], cwd=cwd, check=True, capture_output=True)
+
+
+class TestChangedMode:
+    def test_changed_scopes_reporting_but_not_the_program(
+            self, tmp_path, capsys):
+        """The factory module is COMMITTED (unchanged -> summary-only);
+        the buggy caller is untracked (live).  ``--changed`` must fire
+        JG109 in the caller — proof the whole-program pass still saw
+        the unchanged factory — and report nothing anchored in it."""
+        repo = tmp_path / "repo"
+        repo.mkdir()
+        _git(repo, "init", "-q")
+        (repo / "engine_f.py").write_text(FACTORY_SRC)
+        _git(repo, "add", "engine_f.py")
+        _git(repo, "commit", "-qm", "seed")
+        (repo / "bench_f.py").write_text(CALLER_BAD_SRC)
+        cache = tmp_path / "cache.json"
+        rc = lint_main([str(repo), "--changed", "HEAD",
+                        "--cache", str(cache)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "JG109" in out
+        assert "bench_f.py:4" in out
+        assert "engine_f.py:Trainer._build_fns" in out
+
+        # cache now holds both summaries; a second run reuses the
+        # unchanged one (sha1 hit) and agrees
+        entries = json.loads(cache.read_text())["summaries"]
+        assert any(k.endswith("engine_f.py") for k in entries)
+        rc2 = lint_main([str(repo), "--changed", "HEAD",
+                         "--cache", str(cache)])
+        assert rc2 == 1
+        capsys.readouterr()
+
+    def test_changed_with_clean_worktree_reports_nothing(
+            self, tmp_path, capsys):
+        repo = tmp_path / "repo"
+        repo.mkdir()
+        _git(repo, "init", "-q")
+        (repo / "engine_f.py").write_text(FACTORY_SRC)
+        (repo / "bench_f.py").write_text(CALLER_BAD_SRC)
+        _git(repo, "add", "-A")
+        _git(repo, "commit", "-qm", "seed")
+        # everything committed: nothing is live, so even real findings
+        # in unchanged files are out of scope (the full run owns them)
+        rc = lint_main([str(repo), "--changed", "HEAD"])
+        assert rc == 0
+        capsys.readouterr()
+
+    def test_changed_outside_git_is_a_usage_error(self, tmp_path, capsys):
+        f = tmp_path / "lone.py"
+        f.write_text("x = 1\n")
+        rc = lint_main([str(f), "--changed", "HEAD^{nosuchref}"])
+        # unknown ref inside a repo, or no repo at all: exit 2
+        assert rc == 2
+        capsys.readouterr()
+
+
+class TestDiscardedPureEdges:
+    def test_np_asarray_statement_is_the_blessed_sync_idiom(self):
+        src = ("import numpy as np\nimport jax\n"
+               "def sync(losses, diag):\n"
+               "    np.asarray(losses)\n"
+               "    jax.tree.map(np.asarray, diag)\n")
+        result = _lint_sources(("m.py", src))
+        assert [f for f in result.findings if f.rule_id == "JG111"] == []
+
+    def test_jnp_statement_fires(self):
+        src = ("import jax.numpy as jnp\n"
+               "def f(x):\n    jnp.clip(x, 0, 1)\n    return x\n")
+        result = _lint_sources(("m.py", src))
+        assert [f.rule_id for f in result.findings] == ["JG111"]

@@ -12,8 +12,8 @@ from pathlib import Path
 import pytest
 
 from federated_pytorch_test_tpu.analysis import LintEngine, Severity
+from federated_pytorch_test_tpu.analysis.flow import ALL_RULES
 from federated_pytorch_test_tpu.analysis.lint import main as lint_main
-from federated_pytorch_test_tpu.analysis.rules import ALL_RULES
 
 FIXTURES = Path(__file__).resolve().parent / "lint_fixtures"
 
@@ -26,6 +26,10 @@ CASES = [
     ("jg105_recompile_hazard.py", "JG105"),
     ("jg106_missing_donation.py", "JG106"),
     ("jg107_sharding_annotation.py", "JG107"),
+    ("jg108_cross_function_hazard.py", "JG108"),
+    ("jg109_use_after_donate.py", "JG109"),
+    ("jg110_key_lineage.py", "JG110"),
+    ("jg111_discarded_pure.py", "JG111"),
 ]
 
 
