@@ -371,6 +371,10 @@ def _bench_round(trainer, ci, *, reps, with_comm=False, with_staging=False,
     fields = dict(label=label or f"block_{ci}", N=int(N), K=int(K),
                   round_seconds=dt, images=reps * images_per_epoch,
                   nadmm=reps,
+                  # schema-v5 span bounds: the timed region itself (the
+                  # recorder derives t_end = t_start + round_seconds, and
+                  # obs/trace.py exports it to a Chrome trace timeline)
+                  t_start=t0,
                   # jitted dispatches the host issued inside the timed
                   # region: one epoch (+ one comm) per rep — the fused
                   # engine path collapses the same work to 1/round
@@ -846,6 +850,13 @@ def main():
     # which code produced this artifact (self-description);
     # --dirty so an uncommitted tree cannot masquerade as its HEAD
     out["git"] = _git_describe()
+    # compare-ready baseline pointer: `python -m
+    # federated_pytorch_test_tpu.obs.compare <artifact>` resolves its
+    # baseline from here with no flags — the newest prior measured TPU
+    # artifact, else the published-numbers file
+    _prior = _last_measured_artifact()
+    out["baseline_ref"] = (_prior["path"] if _prior is not None
+                           else "BASELINE.json")
     if not out.get("measured"):
         ref = _last_measured_artifact()
         if ref is not None:

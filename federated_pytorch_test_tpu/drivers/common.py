@@ -58,6 +58,14 @@ def build_parser(defaults: FederatedConfig, prog: str) -> argparse.ArgumentParse
                      "--async-rounds arrival times)")
         elif f.name == "model":
             p.add_argument(arg, choices=MODEL_CHOICES, default=default)
+        elif f.name == "health_action":
+            from federated_pytorch_test_tpu.obs.health import HEALTH_ACTIONS
+            p.add_argument(
+                arg, choices=HEALTH_ACTIONS, default=default,
+                help="streaming watchdog response (obs/health.py): warn "
+                     "emits alert records, abort raises RunHealthAbort, "
+                     "checkpoint-abort saves+verifies a final checkpoint "
+                     "first (default: warn)")
         elif default is None:
             conv = _optional_types.get(f.name)
             if conv is None:

@@ -24,7 +24,7 @@ DEFAULT_FILES = ["L785751.MS_extract.h5", "L785751.MS_extract.h5",
 DEFAULT_SAPS = ["1", "2", "0", "0"]
 
 
-def main(argv=None):
+def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="federated_cpc",
         description="TPU-native federated CPC on LOFAR visibilities")
@@ -53,6 +53,13 @@ def main(argv=None):
     p.add_argument("--obs-sinks", default="auto",
                    help="comma-separated obs sinks "
                         "(auto|none|jsonl|csv|stdout|memory)")
+    from federated_pytorch_test_tpu.obs.health import HEALTH_ACTIONS
+    p.add_argument("--health-action", choices=HEALTH_ACTIONS,
+                   default="warn",
+                   help="streaming watchdog response (obs/health.py): "
+                        "warn emits alert records, abort raises "
+                        "RunHealthAbort, checkpoint-abort verifies a "
+                        "final checkpoint first (default: warn)")
     p.add_argument("--num-devices", type=int, default=None,
                    help="mesh size (default: as many devices as divide K)")
     p.add_argument("--midrun-checkpoint",
@@ -78,7 +85,11 @@ def main(argv=None):
                    action=argparse.BooleanOptionalAction, default=False,
                    help="count jit retraces of the round step and emit "
                         "jit_retraces in the obs round records")
-    args = p.parse_args(argv)
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
 
     from federated_pytorch_test_tpu.drivers.common import setup_runtime
 
@@ -127,7 +138,8 @@ def main(argv=None):
                                  resume=args.load_model and midrun is not None,
                                  async_checkpoint=args.async_checkpoint,
                                  obs_dir=obs_dir, obs_sinks=args.obs_sinks,
-                                 obs_run_name="federated_cpc")
+                                 obs_run_name="federated_cpc",
+                                 health_action=args.health_action)
     print("Finished Training")
     from federated_pytorch_test_tpu.drivers.common import print_obs_artifact
     print_obs_artifact(trainer)

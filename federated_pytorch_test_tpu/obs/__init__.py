@@ -5,12 +5,21 @@
 - :mod:`.sinks`    — JSONL / CSV / stdout / in-memory emitters.
 - :mod:`.recorder` — the per-run emitter the engines thread through.
 - :mod:`.report`   — ``python -m federated_pytorch_test_tpu.obs.report``.
+- :mod:`.trace`    — span timeline → Chrome trace-event JSON exporter.
+- :mod:`.health`   — streaming anomaly watchdog (``--health-action``).
+- :mod:`.compare`  — cross-run regression CLI (CI gate).
 
 See README "Observability" for the artifact format and how XProf traces
 (``--profile-dir`` + per-round ``StepTraceAnnotation``) correlate with
 the JSONL timeline.
 """
 
+from federated_pytorch_test_tpu.obs.health import (  # noqa: F401
+    HEALTH_ACTIONS,
+    HealthMonitor,
+    RunHealthAbort,
+    monitor_from_config,
+)
 from federated_pytorch_test_tpu.obs.metrics import (  # noqa: F401
     Counter,
     Gauge,
@@ -36,4 +45,8 @@ from federated_pytorch_test_tpu.obs.sinks import (  # noqa: F401
     Sink,
     StdoutSink,
     make_sinks,
+)
+from federated_pytorch_test_tpu.obs.trace import (  # noqa: F401
+    to_chrome_trace,
+    validate_chrome_trace,
 )

@@ -1,0 +1,309 @@
+"""Cross-run regression comparison over obs/bench artifacts.
+
+``python -m federated_pytorch_test_tpu.obs.compare RUN... --baseline B``
+diffs N candidate artifacts against a baseline and exits nonzero on
+regression, so CI can gate on it.  Accepted inputs (auto-detected):
+
+- an obs run JSONL (``*.jsonl``) — metrics from
+  :func:`~.report.summarize`: throughput and rounds/sec (higher is
+  better), final loss and comm-overhead fraction (lower is better),
+  compression savings (higher).
+- a bench.py artifact (``artifacts/bench_*.json``) — the headline
+  metric named by its ``metric`` field plus the ``*_ips_chip`` section
+  breakdowns and ``mfu`` (all higher-better).
+- a ``BENCH_rNN.json`` wrapper (``{n, cmd, rc, tail, parsed}``) — the
+  embedded ``parsed`` artifact is unwrapped.
+- ``BASELINE.json`` — its ``published`` dict; when that is empty (no
+  published numbers yet) the comparison says so instead of inventing a
+  verdict.
+
+Honesty about unmeasured data: an artifact with ``measured: false`` has
+value 0.0 by construction; comparing it would manufacture a fake
+regression.  If it embeds a ``last_measured`` reference the headline is
+PROMOTED from there and annotated; otherwise the artifact contributes
+no verdict and the report says "unmeasured".
+
+A candidate bench artifact may carry ``baseline_ref`` (bench.py emits
+it); when no ``--baseline`` flag is given and exactly one candidate is
+compared, that reference is resolved automatically.
+
+Verdicts use a noise-aware relative threshold (``--threshold``, percent,
+default 5%): deltas within the band are "ok(noise)", beyond it "improved"
+or "REGRESSED".  Exit codes: 0 no regression, 1 regression, 2 usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+#: metric name -> +1 (higher is better) / -1 (lower is better)
+_DIRECTION = {
+    "images_per_sec": +1,
+    "rounds_per_sec": +1,
+    "compression_savings_frac": +1,
+    "loss_final": -1,
+    "comm_overhead_frac": -1,
+    "mfu": +1,
+    "value": +1,
+}
+
+
+def _direction(name: str) -> int:
+    if name in _DIRECTION:
+        return _DIRECTION[name]
+    if name.endswith("_ips_chip") or name.endswith("_throughput"):
+        return +1
+    return 0        # unknown: report the delta, never a verdict
+
+
+class CompareError(ValueError):
+    """Unusable input (unknown shape, unreadable file)."""
+
+
+def _num(v) -> Optional[float]:
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return float(v)
+    return None
+
+
+def load_source(path: str) -> Dict[str, Any]:
+    """Load one artifact into ``{path, kind, metrics, notes, ...}``."""
+    src: Dict[str, Any] = {"path": path, "kind": "?", "metrics": {},
+                           "notes": [], "baseline_ref": None}
+    if path.endswith(".jsonl"):
+        from federated_pytorch_test_tpu.obs.report import (
+            read_records,
+            summarize,
+        )
+
+        s = summarize(read_records(path))
+        src["kind"] = f"run ({s.get('engine') or '?'}, {s.get('status')})"
+        for k in ("images_per_sec", "rounds_per_sec", "loss_final",
+                  "comm_overhead_frac", "compression_savings_frac"):
+            v = _num(s.get(k))
+            if v is not None:
+                src["metrics"][k] = v
+        if s.get("status") != "completed":
+            src["notes"].append(f"status={s.get('status')}")
+        return src
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise CompareError(f"{path}: {e}")
+    if not isinstance(obj, dict):
+        raise CompareError(f"{path}: expected a JSON object")
+    if isinstance(obj.get("parsed"), dict):       # BENCH_rNN.json wrapper
+        src["notes"].append(f"BENCH wrapper (iteration {obj.get('n')})")
+        obj = obj["parsed"]
+    if "metric" in obj and "value" in obj:        # bench.py artifact
+        src["kind"] = "bench"
+        src["baseline_ref"] = obj.get("baseline_ref")
+        headline = str(obj["metric"])
+        measured = obj.get("measured", True)
+        if measured:
+            v = _num(obj.get("value"))
+            if v is not None:
+                src["metrics"][headline] = v
+            for k, val in obj.items():
+                if k.endswith("_ips_chip") or k == "mfu":
+                    v = _num(val)
+                    if v is not None:
+                        src["metrics"][k] = v
+        else:
+            last = obj.get("last_measured")
+            v = _num(last.get("value")) if isinstance(last, dict) else None
+            if v is not None:
+                src["metrics"][headline] = v
+                src["notes"].append(
+                    "measured=false; headline PROMOTED from "
+                    f"{last.get('path', '?')} ({last.get('captured_utc')})")
+            else:
+                src["notes"].append(
+                    "measured=false and no last_measured reference — "
+                    "no comparable metrics (unmeasured)")
+        return src
+    if isinstance(obj.get("published"), dict):    # BASELINE.json
+        src["kind"] = "baseline"
+        for k, val in obj["published"].items():
+            v = _num(val)
+            if v is not None:
+                src["metrics"][k] = v
+        if not src["metrics"]:
+            src["notes"].append(
+                "BASELINE.json carries no published numbers yet — "
+                "nothing to compare against")
+        return src
+    raise CompareError(f"{path}: unrecognised artifact shape (not a run "
+                       "JSONL, bench artifact, BENCH wrapper, or baseline)")
+
+
+def compare(baseline: Dict[str, Any], candidates: List[Dict[str, Any]],
+            threshold_pct: float = 5.0) -> Dict[str, Any]:
+    """Per-metric deltas + verdicts.  Returns ``{rows, regressions, notes}``."""
+    thr = abs(threshold_pct) / 100.0
+    names: List[str] = []
+    for source in [baseline] + candidates:
+        for k in source["metrics"]:
+            if k not in names:
+                names.append(k)
+    rows = []
+    regressions = 0
+    for name in names:
+        base = baseline["metrics"].get(name)
+        cells = []
+        for c in candidates:
+            v = c["metrics"].get(name)
+            if v is None or base is None:
+                cells.append({"value": v, "delta": None,
+                              "verdict": "n/a" if v is None else "no-base"})
+                continue
+            delta = (v - base) / abs(base) if base else (0.0 if v == base
+                                                         else float("inf"))
+            sign = _direction(name)
+            if sign == 0:
+                verdict = "info"
+            elif abs(delta) <= thr:
+                verdict = "ok(noise)"
+            elif delta * sign > 0:
+                verdict = "improved"
+            else:
+                verdict = "REGRESSED"
+                regressions += 1
+            cells.append({"value": v, "delta": delta, "verdict": verdict})
+        rows.append({"metric": name, "baseline": base, "cells": cells})
+    notes = [f"{s['path']}: {n}" for s in [baseline] + candidates
+             for n in s["notes"]]
+    return {"rows": rows, "regressions": regressions, "notes": notes,
+            "threshold_pct": abs(threshold_pct)}
+
+
+def _fmt(v: Optional[float]) -> str:
+    if v is None:
+        return "—"
+    return f"{v:,.4g}"
+
+
+def render_markdown(result: Dict[str, Any], baseline: Dict[str, Any],
+                    candidates: List[Dict[str, Any]]) -> str:
+    """``accuracy_comparison``-style markdown matrix."""
+    lines = [f"## Run comparison (threshold ±{result['threshold_pct']:g}%)",
+             "",
+             f"Baseline: `{baseline['path']}` ({baseline['kind']})", ""]
+    hdr = ["metric", "baseline"] + [os.path.basename(c["path"])
+                                    for c in candidates]
+    lines.append("| " + " | ".join(hdr) + " |")
+    lines.append("|" + "---|" * len(hdr))
+    for row in result["rows"]:
+        cells = [row["metric"], _fmt(row["baseline"])]
+        for cell in row["cells"]:
+            if cell["delta"] is None:
+                cells.append(f"{_fmt(cell['value'])} ({cell['verdict']})")
+            else:
+                cells.append(f"{_fmt(cell['value'])} "
+                             f"({cell['delta']:+.1%}, {cell['verdict']})")
+        lines.append("| " + " | ".join(cells) + " |")
+    if not result["rows"]:
+        lines.append("*(no comparable metrics)*")
+    for n in result["notes"]:
+        lines.append(f"- note: {n}")
+    lines.append("")
+    lines.append(f"**{result['regressions']} regression(s)**")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m federated_pytorch_test_tpu.obs.compare",
+        description="Diff run/bench artifacts against a baseline; exit 1 "
+                    "on regression (CI gate)")
+    p.add_argument("paths", nargs="+",
+                   help="candidate artifacts (run .jsonl, bench .json, "
+                        "BENCH_rNN.json)")
+    p.add_argument("--baseline", help="baseline artifact; defaults to the "
+                   "single candidate's embedded baseline_ref")
+    p.add_argument("--threshold", type=float, default=5.0,
+                   help="noise band, percent (default 5)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the comparison as JSON instead of markdown")
+    args = p.parse_args(argv)
+    try:
+        candidates = [load_source(pth) for pth in args.paths]
+        base_path = args.baseline
+        if base_path is None:
+            refs = [c["baseline_ref"] for c in candidates
+                    if c.get("baseline_ref")]
+            if len(candidates) == 1 and refs:
+                ref = refs[0]
+                if not os.path.exists(ref):   # refs are repo-root relative
+                    rel = os.path.join(os.path.dirname(args.paths[0]) or ".",
+                                       ref)
+                    ref = rel if os.path.exists(rel) else ref
+                base_path = ref
+                print(f"(baseline from artifact baseline_ref: {base_path})",
+                      file=sys.stderr)
+        if base_path is None:
+            p.error("--baseline is required (no candidate carries a "
+                    "baseline_ref)")
+        baseline = load_source(base_path)
+    except CompareError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    result = compare(baseline, candidates, args.threshold)
+    if args.json:
+        print(json.dumps({"baseline": baseline["path"],
+                          "candidates": [c["path"] for c in candidates],
+                          **result}))
+    else:
+        print(render_markdown(result, baseline, candidates))
+    return 1 if result["regressions"] else 0
+
+
+def selftest() -> None:
+    """Self-vs-self exits 0; a synthetic regression exits 1; used by
+    ``report --selftest``."""
+    import contextlib
+    import io
+    import tempfile
+
+    def run(argv):
+        with contextlib.redirect_stdout(io.StringIO()), \
+                contextlib.redirect_stderr(io.StringIO()):
+            return main(argv)
+
+    art = {"metric": "cifar10_resnet18_consensus_full_round_throughput",
+           "value": 30000.0, "unit": "images/sec/chip", "measured": True,
+           "stem_block_ips_chip": 26000.0, "mfu": 0.36}
+    with tempfile.TemporaryDirectory() as d:
+        base = os.path.join(d, "base.json")
+        with open(base, "w") as f:
+            json.dump(art, f)
+        same = os.path.join(d, "same.json")
+        with open(same, "w") as f:
+            json.dump(dict(art, baseline_ref=base), f)
+        rc = run([same])                        # baseline via baseline_ref
+        assert rc == 0, f"self-vs-self must exit 0, got {rc}"
+        regressed = os.path.join(d, "regressed.json")
+        with open(regressed, "w") as f:
+            json.dump(dict(art, value=20000.0, mfu=0.24), f)
+        rc = run([regressed, "--baseline", base])
+        assert rc == 1, f"regressed artifact must exit 1, got {rc}"
+        unmeasured = os.path.join(d, "unmeasured.json")
+        with open(unmeasured, "w") as f:
+            json.dump({"metric": art["metric"], "value": 0.0,
+                       "measured": False}, f)
+        rc = run([unmeasured, "--baseline", base])
+        assert rc == 0, f"unmeasured artifact must not fake a regression"
+        src = load_source(unmeasured)
+        assert not src["metrics"] and src["notes"], src
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -106,6 +106,16 @@ class RunRecorder:
         self._last_index: Optional[int] = None
         self._loss_first: Optional[float] = None
         self._loss_final: Optional[float] = None
+        # live run-health layer (schema v5): the run-level span id every
+        # round/phase span parent-links to, the [min, max] host-monotonic
+        # extent of the spans seen (the run span emitted at close), the
+        # attached streaming watchdog (obs/health.py; sink-independent —
+        # it observes round records even when no sink is configured), and
+        # the alert tally surfaced on the summary
+        self.run_span_id: Optional[str] = None
+        self.health = None
+        self._span_extent: Optional[List[float]] = None
+        self._alerts = 0
 
     @property
     def memory(self) -> Optional[List[dict]]:
@@ -121,6 +131,25 @@ class RunRecorder:
             s.emit(rec)
         return rec
 
+    def attach_health(self, monitor) -> None:
+        """Tap a :class:`~..obs.health.HealthMonitor` into the round
+        stream.  In-process and sink-independent: the monitor observes
+        every round record (and can trip an abort) even when no sink is
+        configured; its alert records only hit disk when sinks exist."""
+        self.health = monitor
+        if monitor is not None:
+            monitor.recorder = self
+
+    def _grow_extent(self, t_start, t_end) -> None:
+        if not (isinstance(t_start, (int, float))
+                and isinstance(t_end, (int, float))):
+            return
+        if self._span_extent is None:
+            self._span_extent = [float(t_start), float(t_end)]
+        else:
+            self._span_extent[0] = min(self._span_extent[0], float(t_start))
+            self._span_extent[1] = max(self._span_extent[1], float(t_end))
+
     def open(self, *, config: Optional[dict] = None,
              mesh_shape: Optional[dict] = None, resumed: bool = False,
              rounds_prior: int = 0,
@@ -129,6 +158,7 @@ class RunRecorder:
         self._opened = True
         self._t0 = time.monotonic()
         self._last_index = rounds_prior - 1 if rounds_prior else None
+        self.run_span_id = uuid.uuid4().hex[:12]
         if not self.enabled:
             return None
         import jax
@@ -137,6 +167,7 @@ class RunRecorder:
         rec: Dict[str, Any] = {
             "event": "run_header", "schema": SCHEMA_VERSION,
             "run_id": self.run_id, "run_name": self.run_name,
+            "span_id": self.run_span_id,
             "engine": self.engine, "time_unix": time.time(),
             "devices": jax.device_count(),
             "local_devices": jax.local_device_count(),
@@ -160,8 +191,17 @@ class RunRecorder:
         return self._emit(rec)
 
     def round(self, fields: Dict[str, Any]) -> Optional[dict]:
-        """Emit one round record; enforces monotone ``round_index``."""
-        if not self.enabled:
+        """Emit one round record; enforces monotone ``round_index``.
+
+        When the caller includes a numeric ``t_start`` (host
+        ``perf_counter`` at round entry) the record doubles as the
+        round's SPAN: it gains ``span_id``/``parent_span``/``t_end``
+        (schema v5, additive).  Without ``t_start`` the record is
+        emitted exactly as in v4 — no span fields, no run span at
+        close — so pre-v5 consumers and the lifecycle tests see an
+        unchanged stream.
+        """
+        if not self.enabled and self.health is None:
             return None
         idx = fields.get("round_index")
         if not isinstance(idx, int):
@@ -177,22 +217,83 @@ class RunRecorder:
         if self.algorithm is not None:
             rec["algorithm"] = self.algorithm
         rec.update(json_safe(fields))
-        self.totals.counter("rounds").inc()
-        for k in _SUMMED:
-            v = rec.get(k)
-            if isinstance(v, (int, float)) and not isinstance(v, bool):
-                self.totals.counter(k + "_total").inc(v)
-        for k in _SUMMED_SECONDS:
-            v = rec.get(k)
-            if isinstance(v, (int, float)):
-                self.totals.timer(k[: -len("_seconds")]).observe(v)
-        if isinstance(rec.get("quarantined"), int):
-            self.totals.gauge("quarantined_last").set(rec["quarantined"])
-        loss = rec.get("loss")
-        if isinstance(loss, (int, float)):
-            if self._loss_first is None:
-                self._loss_first = float(loss)
-            self._loss_final = float(loss)
+        t_start = rec.get("t_start")
+        if (isinstance(t_start, (int, float))
+                and not isinstance(t_start, bool)):
+            rec.setdefault("span_id", uuid.uuid4().hex[:12])
+            if self.run_span_id is not None:
+                rec.setdefault("parent_span", self.run_span_id)
+            if "t_end" not in rec:
+                secs = rec.get("round_seconds")
+                if isinstance(secs, (int, float)):
+                    rec["t_end"] = float(t_start) + float(secs)
+            self._grow_extent(t_start, rec.get("t_end", t_start))
+        if self.enabled:
+            self.totals.counter("rounds").inc()
+            for k in _SUMMED:
+                v = rec.get(k)
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    self.totals.counter(k + "_total").inc(v)
+            for k in _SUMMED_SECONDS:
+                v = rec.get(k)
+                if isinstance(v, (int, float)):
+                    self.totals.timer(k[: -len("_seconds")]).observe(v)
+            if isinstance(rec.get("quarantined"), int):
+                self.totals.gauge("quarantined_last").set(rec["quarantined"])
+            loss = rec.get("loss")
+            if isinstance(loss, (int, float)):
+                if self._loss_first is None:
+                    self._loss_first = float(loss)
+                self._loss_final = float(loss)
+            out = self._emit(rec)
+        else:
+            out = rec  # watchdog-only mode: observe, never write
+        if self.health is not None:
+            self.health.observe(rec)
+        return out
+
+    def span(self, name: str, t_start: float, t_end: float, *,
+             cat: str = "phase", round_index: Optional[int] = None,
+             parent_span: Optional[str] = None,
+             span_id: Optional[str] = None,
+             extra: Optional[dict] = None) -> Optional[dict]:
+        """Emit a phase/sub-operation span record (schema v5).
+
+        Timestamps are host-monotonic (``time.perf_counter``); device
+        phases must bound them with the engines' EXISTING ``_obs_sync``
+        barriers — ``span()`` itself never touches the device.
+        """
+        if not self.enabled:
+            return None
+        rec: Dict[str, Any] = {
+            "event": "span", "schema": SCHEMA_VERSION,
+            "run_id": self.run_id,
+            "span_id": span_id or uuid.uuid4().hex[:12],
+            "name": str(name), "cat": str(cat),
+            "t_start": float(t_start), "t_end": float(t_end),
+        }
+        parent = parent_span or self.run_span_id
+        if parent is not None:
+            rec["parent_span"] = parent
+        if round_index is not None:
+            rec["round_index"] = int(round_index)
+        if extra:
+            rec.update(json_safe(extra))
+        self._grow_extent(rec["t_start"], rec["t_end"])
+        return self._emit(rec)
+
+    def alert(self, fields: Dict[str, Any]) -> Optional[dict]:
+        """Emit a watchdog alert record (schema v5).
+
+        Counted toward the summary's ``alerts_total`` even when no sink
+        is attached (the watchdog still ran); written only when one is.
+        """
+        self._alerts += 1
+        if not self.enabled:
+            return None
+        rec = {"event": "alert", "schema": SCHEMA_VERSION,
+               "run_id": self.run_id, "time_unix": time.time()}
+        rec.update(json_safe(fields))
         return self._emit(rec)
 
     def close(self, status: str = "completed",
@@ -203,6 +304,17 @@ class RunRecorder:
         self._closed = True
         if not self.enabled:
             return None
+        if self._span_extent is not None and self.run_span_id is not None:
+            # the run-level span closes the hierarchy; extent is the
+            # min/max of observed span timestamps (perf_counter clock —
+            # NOT self._t0, which is time.monotonic with a different base)
+            self._emit({
+                "event": "span", "schema": SCHEMA_VERSION,
+                "run_id": self.run_id, "span_id": self.run_span_id,
+                "name": "run", "cat": "run",
+                "t_start": self._span_extent[0],
+                "t_end": self._span_extent[1],
+            })
         snap = self.totals.snapshot()
         rounds = int(snap.get("rounds", 0))
         rec: Dict[str, Any] = {
@@ -226,6 +338,8 @@ class RunRecorder:
         if self._loss_first is not None:
             rec["loss_first"] = self._loss_first
             rec["loss_final"] = self._loss_final
+        if self._alerts or self.health is not None:
+            rec["alerts_total"] = self._alerts
         rs = rec.get("round_seconds_total", 0.0)
         if rounds and rs:
             rec["rounds_per_sec"] = rounds / rs

@@ -46,8 +46,16 @@ def read_records(path: str, validate: bool = True) -> List[Dict[str, Any]]:
 
 
 def record_ips(rec: Dict[str, Any], n_chips: int = 1) -> float:
-    """images/sec(/chip) of one round record (bench throughput unit)."""
-    return rec["images"] / rec["round_seconds"] / max(n_chips, 1)
+    """images/sec(/chip) of one round record (bench throughput unit).
+
+    ``round_seconds == 0`` is possible on very fast fused rounds and on
+    synthetic selftest records — report inf-safe throughput (``inf`` if
+    any images moved, else 0.0) instead of raising ZeroDivisionError.
+    """
+    secs = rec["round_seconds"]
+    if secs == 0:
+        return float("inf") if rec["images"] else 0.0
+    return rec["images"] / secs / max(n_chips, 1)
 
 
 def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
@@ -105,6 +113,29 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         out["compression_savings_frac"] = (
             1.0 - (out["bytes_on_wire_total"] or 0)
             / out["bytes_dense_total"])
+    # buffered-async telemetry (schema v4)
+    async_rounds = [r for r in rounds if r.get("async_mode")]
+    out["async_rounds"] = len(async_rounds)
+    depths = [r["buffer_depth"] for r in rounds
+              if isinstance(r.get("buffer_depth"), int)]
+    out["buffer_depth_peak"] = max(depths) if depths else None
+    out["admission_rejected_total"] = tot("admission_rejected")
+    hists = [r["staleness_hist"] for r in rounds
+             if isinstance(r.get("staleness_hist"), list)]
+    if hists:
+        width = max(len(h) for h in hists)
+        total = [0] * width
+        for h in hists:
+            for i, v in enumerate(h):
+                if isinstance(v, (int, float)):
+                    total[i] += int(v)
+        out["staleness_hist_total"] = total
+    else:
+        out["staleness_hist_total"] = None
+    # watchdog alerts (schema v5)
+    alerts = [r for r in records if r.get("event") == "alert"]
+    out["alerts"] = len(alerts)
+    out["alert_rules"] = sorted({a.get("rule", "?") for a in alerts})
     return out
 
 
@@ -160,6 +191,16 @@ def format_report(s: Dict[str, Any]) -> str:
             f"straggle={faults['fault_straggled'] or 0} "
             f"corrupt={faults['fault_corrupted'] or 0} "
             f"quarantined_last={s.get('quarantined_last') or 0}")
+    if s.get("async_rounds"):
+        msg = (f"{s['async_rounds']} async round(s), "
+               f"peak buffer_depth={s.get('buffer_depth_peak') or 0}, "
+               f"admission_rejected={s.get('admission_rejected_total') or 0}")
+        if s.get("staleness_hist_total"):
+            msg += f", staleness_hist={s['staleness_hist_total']}"
+        row("async", msg)
+    if s.get("alerts"):
+        row("health alerts",
+            f"{s['alerts']} alert(s): {', '.join(s.get('alert_rules') or [])}")
     if s.get("loss_first") is not None:
         row("loss", f"first={s['loss_first']:.6g} "
             f"final={s['loss_final']:.6g}")
@@ -167,7 +208,10 @@ def format_report(s: Dict[str, Any]) -> str:
 
 
 def selftest() -> str:
-    """Recorder → JSONL → parse → validate → summarise round-trip."""
+    """Recorder → JSONL → parse → validate → summarise round-trip, plus
+    the trace-exporter, watchdog, and compare selftests (tier-1 runs
+    this, so the whole live-health layer is exercised without a prior
+    training run)."""
     import os
     import tempfile
 
@@ -184,7 +228,10 @@ def selftest() -> str:
                        "stage_seconds": 0.01, "comm_seconds": 0.1,
                        "bytes_on_wire": 100, "bytes_dense": 400,
                        "images": 256, "guard_trips": 1 if i == 2 else 0,
-                       "quarantined": 0})
+                       "quarantined": 0,
+                       "async_mode": True, "max_staleness": 2,
+                       "async_arrived": 2, "admission_rejected": i,
+                       "buffer_depth": i, "staleness_hist": [2, 0, 0]})
         rec.close()
         path = os.path.join(d, "selftest.jsonl")
         records = read_records(path)
@@ -197,8 +244,25 @@ def selftest() -> str:
         assert s["guard_trips_total"] == 1, s
         assert s["loss_final"] == 1.0, s
         assert s["status"] == "completed", s
+        assert s["async_rounds"] == 3, s
+        assert s["buffer_depth_peak"] == 2, s
+        assert s["admission_rejected_total"] == 3, s
+        assert s["staleness_hist_total"] == [6, 0, 0], s
         table = format_report(s)
-    return table + "\nobs report selftest: OK"
+        assert "async" in table, table
+    assert record_ips({"images": 256, "round_seconds": 0}) == float("inf")
+    assert record_ips({"images": 0, "round_seconds": 0}) == 0.0
+
+    from federated_pytorch_test_tpu.obs import compare, health, trace
+
+    trace.selftest()
+    health.selftest()
+    compare.selftest()
+    return (table
+            + "\nobs trace selftest: OK (Chrome trace valid)"
+            + "\nobs health selftest: OK (NaN streak alerted)"
+            + "\nobs compare selftest: OK (regression gate works)"
+            + "\nobs report selftest: OK")
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -1,6 +1,6 @@
 """Versioned record schema for run telemetry.
 
-One run = one JSONL stream of three event kinds:
+One run = one JSONL stream of five event kinds:
 
 - ``run_header``  — emitted once when a run (or resumed segment) opens:
   config snapshot, mesh shape, jax/backend versions, git rev.
@@ -10,6 +10,12 @@ One run = one JSONL stream of three event kinds:
   device memory stats where the backend reports them.
 - ``summary``     — emitted once when the run closes (``completed`` or
   ``aborted``): totals and derived rates.
+- ``span``        — one per phase/sub-span (schema v5): a parent-linked
+  node of the run -> round -> phase timeline; export with
+  ``python -m federated_pytorch_test_tpu.obs.trace``.
+- ``alert``       — a streaming-watchdog verdict (schema v5;
+  ``obs/health.py``): which rule tripped, on which round, and what the
+  configured ``--health-action`` did about it.
 
 The schema unifies what ``engine.py``, ``cpc_engine.py`` and
 ``vae_engine.py`` used to build as ad-hoc dicts; every record carries
@@ -38,10 +44,17 @@ from typing import Any, Dict
 # max_staleness, discarded), `buffer_depth` (updates still in flight
 # after the round), and `staleness_hist` (admitted deliveries bucketed by
 # staleness 0..max_staleness).
-# v1..v3 records remain valid: validate_record accepts ver <= SCHEMA_VERSION.
-SCHEMA_VERSION = 4
+# v5 (additive): the live run-health layer — parent-linked span ids
+# (`span_id` on run_header/round, `parent_span` + host-monotonic
+# `t_start`/`t_end` on round records), a new `span` record kind (the
+# run -> round -> phase timeline, exported to Chrome trace-event JSON by
+# obs/trace.py and keyed to the same `round_index` the XProf round_trace
+# annotations use), a new `alert` record kind (obs/health.py streaming
+# watchdog verdicts), and `alerts_total` on the summary.
+# v1..v4 records remain valid: validate_record accepts ver <= SCHEMA_VERSION.
+SCHEMA_VERSION = 5
 
-EVENTS = ("run_header", "round", "summary")
+EVENTS = ("run_header", "round", "summary", "span", "alert")
 
 
 class SchemaError(ValueError):
@@ -67,7 +80,7 @@ FIELDS: Dict[str, Any] = {
     "engine":       (("run_header", "round"), _STR),
     "algorithm":    (("run_header", "round"), _STR),
     # header
-    "time_unix":    (("run_header", "summary"), _NUM),
+    "time_unix":    (("run_header", "summary", "alert"), _NUM),
     "config":       (("run_header",), _DICT),
     "mesh_shape":   (("run_header",), _DICT),
     "devices":      (("run_header",), _INT),
@@ -80,8 +93,9 @@ FIELDS: Dict[str, Any] = {
     "rounds_prior": (("run_header",), _INT),
     "host":         (("run_header",), _STR),
     "pid":          (("run_header",), _INT),
-    # round coordinates
-    "round_index":  (("round",), _INT),
+    # round coordinates (spans and alerts are keyed to the same index the
+    # XProf round_trace annotations use, so all three timelines correlate)
+    "round_index":  (("round", "span", "alert"), _INT),
     "nloop":        (("round",), _INT),
     "block":        (("round",), _INT),
     "nadmm":        (("round",), _INT),
@@ -133,6 +147,25 @@ FIELDS: Dict[str, Any] = {
     # device memory (absent when the backend reports none, e.g. CPU)
     "mem_bytes_in_use": (("round",), _INT),
     "mem_peak_bytes_in_use": (("round",), _INT),
+    # span tracing (schema v5; obs/trace.py).  `span_id`/`parent_span`
+    # ride additively on existing records; `t_start`/`t_end` are HOST
+    # MONOTONIC (time.perf_counter) stamps taken at the phase boundaries
+    # the engines already time — device-phase durations come from the
+    # existing `_obs_sync` sync points, no new syncs are introduced.
+    "span_id":      (("run_header", "round", "span"), _STR),
+    "parent_span":  (("round", "span"), _STR),
+    "t_start":      (("round", "span"), _NUM),
+    "t_end":        (("round", "span"), _NUM),
+    "name":         (("span",), _STR),        # phase/sub-span label
+    "cat":          (("span",), _STR),        # run|round|phase|comm|ckpt|...
+    # streaming watchdog verdicts (schema v5; obs/health.py)
+    "rule":         (("alert",), _STR),
+    "severity":     (("alert",), _STR),       # warn|fatal
+    "message":      (("alert",), _STR),
+    "observed":     (("alert",), _NUM),       # value that tripped the rule
+    "threshold":    (("alert",), _NUM),
+    "streak":       (("alert",), _INT),       # consecutive bad rounds
+    "action":       (("alert",), _STR),       # health_action at trip time
     # summary totals / rates
     "status":       (("summary",), _STR),
     "rounds":       (("summary",), _INT),
@@ -154,6 +187,7 @@ FIELDS: Dict[str, Any] = {
     "images_per_sec": (("summary",), _NUM),
     "comm_overhead_frac": (("summary",), _NUM),
     "compression_savings_frac": (("summary",), _NUM),
+    "alerts_total": (("summary",), _INT),
 }
 
 REQUIRED = {
@@ -161,6 +195,9 @@ REQUIRED = {
     "round": ("event", "schema", "run_id", "round_index", "engine",
               "round_seconds"),
     "summary": ("event", "schema", "run_id", "status", "rounds"),
+    "span": ("event", "schema", "run_id", "span_id", "name", "t_start",
+             "t_end"),
+    "alert": ("event", "schema", "run_id", "rule", "round_index"),
 }
 
 

@@ -222,6 +222,23 @@ class FederatedConfig:
     obs_dir: Optional[str] = None
     obs_sinks: str = "auto"
 
+    # streaming run-health watchdog (obs/health.py): per-round rules on
+    # the SAME values the obs round records already carry (non-finite
+    # loss streaks, loss divergence vs an EMA envelope, throughput
+    # collapse vs a rolling median, guard/quarantine spikes, async
+    # buffer backlog / admission blowups, zero-progress streaks).
+    # health_action picks what a trip does: "off" (no monitor at all),
+    # "warn" (alert records only — default), "abort" (raise
+    # RunHealthAbort), "checkpoint-abort" (force a final verified
+    # checkpoint through the existing writers, then raise).  The
+    # watchdog only observes — no device syncs, training math
+    # bit-identical (tested).
+    health_action: str = "warn"
+    health_streak: int = 3        # consecutive bad rounds before an alert
+    health_window: int = 8        # EMA warm-up / rolling-median window
+    health_loss_mult: float = 10.0  # divergence envelope multiplier
+    health_tput_frac: float = 0.25  # collapse floor vs rolling median
+
     # runtime sanitizers (analysis/sanitize.py) — both default-off, and
     # with both off the engine builds the literal uninstrumented
     # jax.jit(shard_map(...)) chain (bit-identical dense path, same

@@ -398,7 +398,8 @@ class CPCTrainer:
             checkpoint_path: Optional[str] = None, resume: bool = False,
             async_checkpoint: bool = False,
             obs_dir: Optional[str] = None, obs_sinks: str = "auto",
-            obs_run_name: str = "cpc_admm"):
+            obs_run_name: str = "cpc_admm",
+            health_action: str = "warn"):
         """The rotation loop (federated_cpc.py:194-304).
 
         ``profile_dir`` wraps the run in ``jax.profiler.trace``
@@ -435,6 +436,12 @@ class CPCTrainer:
         slot rotation to a background writer thread (the device state is
         snapshotted to host first, so it composes with donation); the
         on-disk slot protocol and corrupt-slot fallback are unchanged.
+
+        ``health_action`` arms the streaming watchdog (obs/health.py) on
+        the round stream: "off" | "warn" (default) | "abort" |
+        "checkpoint-abort" (same contract as the classifier engine's
+        ``--health-action``; with no ``checkpoint_path`` a
+        checkpoint-abort trip degrades to a plain abort).
         """
         with profile_ctx(profile_dir):
             return self._run_impl(Nloop, Nadmm, state, log, prefetch,
@@ -442,17 +449,29 @@ class CPCTrainer:
                                   async_checkpoint=async_checkpoint,
                                   profile_on=profile_dir is not None,
                                   obs_dir=obs_dir, obs_sinks=obs_sinks,
-                                  obs_run_name=obs_run_name)
+                                  obs_run_name=obs_run_name,
+                                  health_action=health_action)
 
     def _run_impl(self, Nloop, Nadmm, state, log, prefetch,
                   checkpoint_path=None, resume=False, async_checkpoint=False,
                   profile_on=False,
-                  obs_dir=None, obs_sinks="auto", obs_run_name="cpc_admm"):
+                  obs_dir=None, obs_sinks="auto", obs_run_name="cpc_admm",
+                  health_action="warn"):
+        from federated_pytorch_test_tpu.obs.health import (
+            HEALTH_ACTIONS,
+            HealthMonitor,
+            RunHealthAbort,
+        )
         from federated_pytorch_test_tpu.utils.checkpoint import (
             CheckpointCorruptError,
             checkpoint_slots,
+            finalize_checkpoint,
             verify_checkpoint,
         )
+
+        if health_action not in HEALTH_ACTIONS:
+            raise ValueError(f"health_action={health_action!r} must be one "
+                             f"of {HEALTH_ACTIONS}")
 
         state = state or self.state0
         if self._donate:
@@ -529,6 +548,9 @@ class CPCTrainer:
                          "prefetch": bool(prefetch)},
                  mesh_shape=dict(self.mesh.shape), resumed=restored,
                  rounds_prior=len(history))
+        if health_action != "off":
+            obs.attach_health(HealthMonitor(action=health_action,
+                                            n_clients=self.K))
         self.obs_recorder = obs
         try:
             for nloop in range(Nloop):
@@ -612,11 +634,49 @@ class CPCTrainer:
                                                       history)
                                     rec["ckpt_write_seconds"] = (
                                         time.perf_counter() - t_ckpt)
-                                if obs.enabled:
-                                    obs.round(dict(
-                                        rec, round_index=len(history) - 1,
+                                if obs.enabled or obs.health is not None:
+                                    ridx = len(history) - 1
+                                    rrec = obs.round(dict(
+                                        rec, round_index=ridx,
                                         bytes_dense=4 * N * self.K,
+                                        t_start=t_round,
                                         **device_memory_stats()))
+                                    if obs.enabled:
+                                        rspan = (rrec or {}).get("span_id")
+                                        obs.span("stage", t_round, t_staged,
+                                                 cat="phase", round_index=ridx,
+                                                 parent_span=rspan)
+                                        obs.span("compute", t_staged, t_done,
+                                                 cat="phase", round_index=ridx,
+                                                 parent_span=rspan)
+                                        if "ckpt_write_seconds" in rec:
+                                            # after t_done: hangs off the
+                                            # RUN span (laminar nesting)
+                                            obs.span(
+                                                "ckpt", t_ckpt, t_ckpt
+                                                + rec["ckpt_write_seconds"],
+                                                cat="ckpt", round_index=ridx)
+                                    if (obs.health is not None
+                                            and obs.health.tripped
+                                            is not None):
+                                        alert = obs.health.tripped
+                                        log(f"health: rule "
+                                            f"{alert.get('rule')!r} tripped "
+                                            f"on round "
+                                            f"{alert.get('round_index')} "
+                                            f"(action={obs.health.action})")
+                                        if (obs.health.action
+                                                == "checkpoint-abort"
+                                                and checkpoint_path
+                                                is not None):
+                                            # this round already saved; just
+                                            # drain the writer and verify
+                                            self._flush_ckpt_writer()
+                                            slot = finalize_checkpoint(
+                                                checkpoint_path)
+                                            log("health: final checkpoint "
+                                                f"verified at {slot}")
+                                        raise RunHealthAbort(alert)
                                 log(f"dual (N={N},loop={nloop},model={mdl},"
                                     f"block={ci},avg={nadmm})="
                                     f"{rec['dual_residual']:e} "

@@ -164,6 +164,21 @@ class BlockwiseFederatedTrainer:
         if cfg.quarantine_rounds < 0:
             raise ValueError(
                 f"quarantine_rounds={cfg.quarantine_rounds} must be >= 0")
+        from federated_pytorch_test_tpu.obs.health import HEALTH_ACTIONS
+        if cfg.health_action not in HEALTH_ACTIONS:
+            raise ValueError(
+                f"health_action={cfg.health_action!r} must be one of "
+                f"{HEALTH_ACTIONS}")
+        if cfg.health_streak < 1:
+            raise ValueError(
+                f"health_streak={cfg.health_streak} must be >= 1")
+        if cfg.health_window < 2:
+            raise ValueError(
+                f"health_window={cfg.health_window} must be >= 2")
+        if cfg.health_loss_mult <= 1 or cfg.health_tput_frac <= 0:
+            raise ValueError(
+                "health_loss_mult must be > 1 and health_tput_frac > 0 "
+                f"(got {cfg.health_loss_mult}, {cfg.health_tput_frac})")
         if cfg.guard_norm_mult <= 0:
             raise ValueError(
                 f"guard_norm_mult={cfg.guard_norm_mult} must be positive")
@@ -1393,6 +1408,12 @@ class BlockwiseFederatedTrainer:
             algorithm=self.algo.name)
         rec.open(config=_dc.asdict(cfg), mesh_shape=dict(self.mesh.shape),
                  resumed=resumed, rounds_prior=rounds_prior)
+        # live run-health watchdog (obs/health.py): attached even when no
+        # sink is configured — it only reads the per-round values the
+        # engine already fetched at the round boundary, so "off" vs
+        # "warn" is bit-identical training math either way
+        from federated_pytorch_test_tpu.obs.health import monitor_from_config
+        monitor_from_config(cfg, recorder=rec)
         self.obs_recorder = rec
         return rec
 
@@ -1434,6 +1455,41 @@ class BlockwiseFederatedTrainer:
         writer, self._ckpt_writer = self._ckpt_writer, None
         if writer is not None:
             writer.close()
+
+    def _health_abort(self, obs, checkpoint_path, state, blockvars, nxt,
+                      history, log=print):
+        """A watchdog rule tripped with a fatal ``--health-action``.
+
+        ``checkpoint-abort``: the tripping round already went through
+        ``_save_midrun`` when mid-run checkpointing is on; otherwise a
+        one-off save lands at ``<checkpoint_dir>/<run_name>_health_abort``.
+        Either way the async writer is drained and the newest slot is
+        checksum-verified BEFORE raising, so the run dies with a
+        proven-good checkpoint on disk.  Always ends in
+        :class:`~..obs.health.RunHealthAbort`; ``run()``'s handler then
+        closes the obs stream with status="aborted".
+        """
+        from federated_pytorch_test_tpu.obs.health import RunHealthAbort
+
+        alert = obs.health.tripped
+        log(f"health: rule {alert.get('rule')!r} tripped on round "
+            f"{alert.get('round_index')} (action={obs.health.action})")
+        if obs.health.action == "checkpoint-abort":
+            from federated_pytorch_test_tpu.utils.checkpoint import (
+                finalize_checkpoint,
+            )
+
+            path = checkpoint_path
+            if path is None:
+                run_name = (self.obs_run_name
+                            or f"{self.obs_engine}_{self.algo.name}")
+                path = os.path.join(self.cfg.checkpoint_dir,
+                                    f"{run_name}_health_abort")
+                self._save_midrun(path, state, blockvars, nxt, history)
+            self._flush_ckpt_writer()
+            slot = finalize_checkpoint(path)
+            log(f"health: final checkpoint verified at {slot}")
+        raise RunHealthAbort(alert)
 
     def __del__(self):
         try:
@@ -1583,6 +1639,7 @@ class BlockwiseFederatedTrainer:
                                    if cfg.update_guard else 0)
                         loss_acc = None       # on-device [K] accumulator: the
                         stage_s = 0.0         # host fetch happens ONCE per round
+                        phase_marks = []      # (name, cat, t0, t1) span bounds
                         dispatch0 = self._host_dispatches
                         run_fused = (self._use_fused and algo.communicates
                                      and n_comm > 0)
@@ -1620,6 +1677,14 @@ class BlockwiseFederatedTrainer:
                             self._obs_sync(obs, state, z, y, loss_acc)
                             train_s = time.perf_counter() - t_train
                             comm_s = 0.0
+                            if obs.enabled:
+                                # span bounds reuse the timestamps just
+                                # taken — no extra syncs (obs/trace.py)
+                                phase_marks = [
+                                    ("stage", "phase", t_stage,
+                                     t_stage + stage_s),
+                                    ("train", "phase", t_train,
+                                     t_train + train_s)]
                         else:
                             t_train = time.perf_counter()
                             for nepoch in range(cfg.Nepoch):
@@ -1631,7 +1696,11 @@ class BlockwiseFederatedTrainer:
                                           and nepoch == cfg.Nepoch - 1))
                                 keys = self._epoch_keys()
                                 self._obs_sync(obs, xb, yb, wb, keys)
-                                stage_s += time.perf_counter() - t_stage
+                                now = time.perf_counter()
+                                stage_s += now - t_stage
+                                if obs.enabled:
+                                    phase_marks.append(
+                                        ("stage", "phase", t_stage, now))
                                 state, losses = train_epoch(
                                     state, y, self.client_norm, keys,
                                     xb, yb, wb, z, rho, active)
@@ -1657,8 +1726,13 @@ class BlockwiseFederatedTrainer:
                             # round's single host sync — see README
                             # "Observability" and PARITY.md
                             self._obs_sync(obs, state, loss_acc)
-                            train_s = (time.perf_counter() - t_train
-                                       - stage_s)
+                            t_train_end = time.perf_counter()
+                            train_s = t_train_end - t_train - stage_s
+                            if obs.enabled:
+                                # the train span covers the epoch chain
+                                # (stage spans nest inside it)
+                                phase_marks.append(
+                                    ("train", "phase", t_train, t_train_end))
                             t_comm = time.perf_counter()
                             if algo.communicates and n_comm > 0:
                                 mode = self._comm_mode(nadmm)
@@ -1692,6 +1766,10 @@ class BlockwiseFederatedTrainer:
                                 diag = {}
                             self._obs_sync(obs, state, z, y)
                             comm_s = time.perf_counter() - t_comm
+                            if obs.enabled and algo.communicates:
+                                phase_marks.append(
+                                    ("comm", "comm", t_comm,
+                                     t_comm + comm_s))
                         t_sync = time.perf_counter()
                         # single host sync per round: the loss fetch depends on
                         # every epoch in the chain and the diag/rho floats on
@@ -1703,6 +1781,9 @@ class BlockwiseFederatedTrainer:
                         loss_sum = (float(np.sum(fetch(loss_acc)))
                                     if loss_acc is not None else 0.0)
                         sync_s = time.perf_counter() - t_sync
+                        if obs.enabled:
+                            phase_marks.append(
+                                ("sync", "phase", t_sync, t_sync + sync_s))
                         rec = dict(nloop=nloop, block=ci, nadmm=nadmm, N=N,
                                    loss=loss_sum, rho=float(rho),
                                    round_seconds=time.perf_counter() - t_round,
@@ -1731,13 +1812,17 @@ class BlockwiseFederatedTrainer:
                         if cfg.check_results:
                             rec["accuracy"] = self.evaluate(state)
                         history.append(rec)
+                        # resume coordinates for the NEXT round (also the
+                        # health watchdog's fallback-save target when it
+                        # trips without mid-run checkpointing on)
+                        if nadmm + 1 < cfg.Nadmm:
+                            nxt = (nloop, ci, nadmm + 1)
+                        elif ci + 1 < self.L:
+                            nxt = (nloop, ci + 1, 0)
+                        else:
+                            nxt = (nloop + 1, 0, 0)
+                        t_ckpt = None
                         if checkpoint_path is not None:
-                            if nadmm + 1 < cfg.Nadmm:
-                                nxt = (nloop, ci, nadmm + 1)
-                            elif ci + 1 < self.L:
-                                nxt = (nloop, ci + 1, 0)
-                            else:
-                                nxt = (nloop + 1, 0, 0)
                             # checkpoint BEFORE the obs emit so the round
                             # record carries its own write cost; under
                             # --async-checkpoint this times only the D2H
@@ -1753,9 +1838,9 @@ class BlockwiseFederatedTrainer:
                                               history)
                             rec["ckpt_write_seconds"] = (
                                 time.perf_counter() - t_ckpt)
-                        if obs.enabled:
+                        if obs.enabled or obs.health is not None:
                             extra = dict(rec, round_index=len(history) - 1,
-                                         images=obs_images,
+                                         images=obs_images, t_start=t_round,
                                          **device_memory_stats())
                             if cfg.async_rounds:
                                 extra["async_mode"] = True
@@ -1765,7 +1850,28 @@ class BlockwiseFederatedTrainer:
                                 # participant's f32 block payload
                                 extra["bytes_dense"] = 4 * N * int(
                                     diag.get("n_active", cfg.K))
-                            obs.round(extra)
+                            rrec = obs.round(extra)
+                            if obs.enabled:
+                                rspan = (rrec or {}).get("span_id")
+                                ridx = extra["round_index"]
+                                for nm, cat, s0, s1 in phase_marks:
+                                    obs.span(nm, s0, s1, cat=cat,
+                                             round_index=ridx,
+                                             parent_span=rspan)
+                                if t_ckpt is not None:
+                                    # the mid-run save runs AFTER
+                                    # round_seconds is measured, so its
+                                    # span hangs off the RUN span to keep
+                                    # nesting laminar (obs/trace.py)
+                                    obs.span("ckpt", t_ckpt, t_ckpt
+                                             + rec["ckpt_write_seconds"],
+                                             cat="ckpt", round_index=ridx)
+                            if (obs.health is not None
+                                    and obs.health.tripped is not None):
+                                self._health_abort(
+                                    obs, checkpoint_path, state,
+                                    (z, y, rho, x0, yhat0), nxt, history,
+                                    log)
                         blk = self.block_ids[ci]
                         msg = (f"block=[{blk[0]},{blk[1]}]({N},{float(rho):f}) "
                                f"round={nadmm}/{nloop} "
@@ -1830,10 +1936,19 @@ class BlockwiseFederatedTrainer:
             else:
                 log(f"Epoch {epoch} loss={rec['loss']:e}")
             history.append(rec)
-            if obs.enabled:
+            if obs.enabled or obs.health is not None:
                 obs.round(dict(rec, round_index=epoch,
                                round_seconds=rec["epoch_seconds"],
-                               images=obs_images,
+                               images=obs_images, t_start=t_epoch,
                                **device_memory_stats()))
+                if (obs.health is not None
+                        and obs.health.tripped is not None):
+                    # no mid-run checkpointing on this path:
+                    # checkpoint-abort degrades to a plain abort
+                    from federated_pytorch_test_tpu.obs.health import (
+                        RunHealthAbort,
+                    )
+
+                    raise RunHealthAbort(obs.health.tripped)
         obs.close()
         return state, history

@@ -139,6 +139,24 @@ def checkpoint_slots(path: str) -> List[str]:
             if os.path.isdir(_abspath(cand))]
 
 
+def finalize_checkpoint(path: str) -> str:
+    """Abort-path barrier: resolve and checksum-verify the newest slot.
+
+    The health watchdog's ``checkpoint-abort`` action calls this AFTER
+    flushing any async writer, so the run dies with a proven-good
+    checkpoint on disk.  Returns the verified slot path.  Raises
+    :class:`CheckpointCorruptError` on checksum mismatch and
+    ``FileNotFoundError`` when no slot exists at all.
+    """
+    newest = newest_slot(path)
+    if newest is None:
+        raise FileNotFoundError(
+            f"no checkpoint slot on disk for {path!r} — nothing to "
+            "finalize on abort")
+    verify_checkpoint(newest)
+    return newest
+
+
 def _is_primary() -> bool:
     return jax.process_index() == 0
 
