@@ -66,7 +66,14 @@ from .rules import (FunctionNode, MODULE_RULES, _donate_ints, _dotted,
                     _fn_param_names)
 
 #: bump when the summary shape changes; stale cache entries re-extract
-SUMMARY_VERSION = 1
+SUMMARY_VERSION = 2
+
+#: bump whenever extraction *logic* or any rule changes behaviour without
+#: changing the summary shape — ``lint --cache`` folds this into its
+#: cache-validity check, so a rule edit invalidates sha1-matched entries
+#: that would otherwise serve stale summaries (the shape-only
+#: SUMMARY_VERSION cannot catch logic changes)
+ANALYSIS_VERSION = 2
 
 #: callable wrappers that pass their first argument's signature through
 _TRANSPARENT_WRAPPERS = {"vmap", "pmap", "jit", "pjit", "shard_map",
@@ -79,6 +86,19 @@ _STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
 
 _AT_METHODS = {"set", "add", "subtract", "multiply", "divide", "power",
                "min", "max", "get", "apply", "mul", "div"}
+
+#: constructor last-names classified for the concurrency pass
+#: (analysis/threads.py); matched on the final attribute so both
+#: ``threading.Lock()`` and a bare imported ``Lock()`` register
+_SYNC_MAKERS = {
+    "Lock": "lock", "RLock": "lock",
+    "Event": "event", "Condition": "event", "Semaphore": "event",
+    "BoundedSemaphore": "event", "Barrier": "event",
+    "Queue": "queue", "LifoQueue": "queue", "PriorityQueue": "queue",
+    "SimpleQueue": "queue",
+    "Thread": "thread",
+    "ThreadPoolExecutor": "pool", "ProcessPoolExecutor": "pool",
+}
 
 
 def file_sha1(text: str) -> str:
@@ -149,6 +169,38 @@ def _loads_in(node: ast.AST) -> List[str]:
     return out
 
 
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X``, ``self.X.y``, ``cls.X`` -> ``X`` — the attribute that
+    names the shared slot on the instance/class.  Anything not rooted at
+    ``self``/``cls`` returns None."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name) and cur.id in ("self", "cls") and parts:
+        return parts[-1]
+    return None
+
+
+def _self_attrs_in(node: ast.AST) -> Set[str]:
+    """Every ``self.X`` slot read inside an expression (outermost
+    attribute per chain; lambda bodies skipped like :func:`_loads_in`)."""
+    out: Set[str] = set()
+    stack: List[ast.AST] = [node]
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, FunctionNode + (ast.Lambda,)):
+            continue
+        if isinstance(cur, ast.Attribute):
+            attr = _self_attr(cur)
+            if attr is not None:
+                out.add(attr)
+                continue
+        stack.extend(ast.iter_child_nodes(cur))
+    return out
+
+
 def _arg_descs(call: ast.Call) -> List[dict]:
     out = []
     for a in call.args:
@@ -181,7 +233,14 @@ class _FnWalker(ast.NodeVisitor):
         self.tuple_binds: Dict[str, List[dict]] = {}
         self.returns: List[List[dict]] = []
         self.derives: List[Tuple[str, List[str]]] = []
+        # --- concurrency effect facts (analysis/threads.py) ---
+        self.spawns: List[dict] = []        # Thread(target=)/pool.submit
+        self.sync_makes: List[dict] = []    # lock/queue/pool/thread ctors
+        self.joins: List[dict] = []         # .join()/.shutdown() sites
+        self.globals: List[str] = []        # `global X` declarations
         self._loop = 0
+        self._held: List[str] = []          # lock tokens held lexically
+        self._checks: List[List[str]] = []  # self-attrs checked by if/while
         self._call_idx_by_node: Dict[int, int] = {}
 
     # ------------------------------------------------------ expressions
@@ -206,12 +265,40 @@ class _FnWalker(ast.NodeVisitor):
                                     "col": node.col_offset,
                                     "loop": self._loop})
             return
+        if isinstance(node, ast.Attribute):
+            attr = _self_attr(node)
+            if attr is not None:
+                if isinstance(node.ctx, ast.Load) \
+                        and attr not in _STATIC_ATTRS:
+                    self._attr_event("aload", attr, node)
+                base = node.value
+                while isinstance(base, ast.Attribute):
+                    base = base.value
+                self.expr(base)      # keep the bare `self` load event
+                return
+            for child in ast.iter_child_nodes(node):
+                self.expr(child)
+            return
         if isinstance(node, ast.NamedExpr):
             self.expr(node.value)
             self._store_target(node.target)
             return
         for child in ast.iter_child_nodes(node):
             self.expr(child)
+
+    def _attr_event(self, t: str, attr: str, node: ast.AST,
+                    rmw: bool = False) -> None:
+        ev: dict = {"t": t, "n": attr, "line": node.lineno,
+                    "col": node.col_offset, "loop": self._loop}
+        if self._held:
+            ev["h"] = sorted(set(self._held))
+        if t == "astore":
+            chk = sorted({a for frame in self._checks for a in frame})
+            if chk:
+                ev["chk"] = chk
+            if rmw:
+                ev["rmw"] = True
+        self.events.append(ev)
 
     def _record_call(self, node: ast.Call) -> None:
         kw = {}
@@ -222,24 +309,75 @@ class _FnWalker(ast.NodeVisitor):
                                    else None),
                              "loads": _loads_in(k.value)}
         idx = len(self.calls)
-        self.calls.append({
+        rec = {
             "line": node.lineno, "col": node.col_offset,
             "callee": _ref_of(node.func),
             "args": _arg_descs(node),
             "kw": kw,
             "assigned": None,
-        })
+        }
+        if self._held:
+            rec["held"] = sorted(set(self._held))
+        self.calls.append(rec)
         self._call_idx_by_node[id(node)] = idx
         self.events.append({"t": "call", "i": idx, "loop": self._loop})
+        self._concurrency_call(node)
+
+    def _concurrency_call(self, node: ast.Call) -> None:
+        """Spawn edges, lock acquire/release, join/shutdown records."""
+        d = _dotted(node.func)
+        if not d:
+            return
+        base, _, last = d.rpartition(".")
+        if last == "acquire" and base:
+            self._held.append(base)       # recorded call is pre-acquire
+        elif last == "release" and base and base in self._held:
+            self._held.remove(base)
+        elif last in ("join", "shutdown") and base:
+            self.joins.append({"token": base, "op": last,
+                               "line": node.lineno})
+        elif last == "submit" and base and node.args:
+            self.spawns.append({"via": "submit", "pool": base,
+                                "target": _ref_of(node.args[0]),
+                                "name": None,
+                                "line": node.lineno,
+                                "col": node.col_offset})
+        elif last == "Thread":
+            tgt = name = None
+            for k in node.keywords:
+                if k.arg == "target":
+                    tgt = _ref_of(k.value)
+                elif k.arg == "name" and isinstance(k.value, ast.Constant):
+                    name = str(k.value.value)
+            if tgt is not None:
+                self.spawns.append({"via": "thread", "pool": None,
+                                    "target": tgt, "name": name,
+                                    "line": node.lineno,
+                                    "col": node.col_offset})
 
     # ------------------------------------------------------- statements
 
-    def _store_target(self, target: ast.AST) -> None:
+    def _store_target(self, target: ast.AST,
+                      value_attrs: Optional[Set[str]] = None) -> None:
         for n in ast.walk(target):
             if isinstance(n, ast.Name) and isinstance(
                     n.ctx, (ast.Store, ast.Del)):
                 self.events.append({"t": "store", "n": n.id,
                                     "loop": self._loop})
+            elif isinstance(n, ast.Attribute) and isinstance(
+                    n.ctx, (ast.Store, ast.Del)):
+                attr = _self_attr(n)
+                if attr is not None:
+                    self._attr_event(
+                        "astore", attr, n,
+                        rmw=bool(value_attrs and attr in value_attrs))
+            elif isinstance(n, ast.Subscript) and isinstance(
+                    n.ctx, (ast.Store, ast.Del)):
+                attr = _self_attr(n.value)
+                if attr is not None:
+                    self._attr_event(
+                        "astore", attr, n,
+                        rmw=bool(value_attrs and attr in value_attrs))
 
     def _target_names(self, target: ast.AST) -> List[str]:
         if isinstance(target, ast.Name):
@@ -274,8 +412,9 @@ class _FnWalker(ast.NodeVisitor):
                     names = self._target_names(node.targets[0])
                     if names:
                         self.calls[ci]["assigned"] = names
+            value_attrs = _self_attrs_in(node.value)
             for target in node.targets:
-                self._store_target(target)
+                self._store_target(target, value_attrs)
             return
         if isinstance(node, ast.AugAssign):
             if isinstance(node.target, ast.Name):
@@ -284,8 +423,15 @@ class _FnWalker(ast.NodeVisitor):
                                     "col": node.col_offset,
                                     "loop": self._loop})
                 self.derives.append((node.target.id, _loads_in(node.value)))
+            else:
+                tbase = (node.target.value
+                         if isinstance(node.target, ast.Subscript)
+                         else node.target)
+                attr = _self_attr(tbase)
+                if attr is not None:    # self.x += 1: read-modify-write
+                    self._attr_event("aload", attr, node.target)
             self.expr(node.value)
-            self._store_target(node.target)
+            self._store_target(node.target, _self_attrs_in(node.target))
             return
         if isinstance(node, ast.AnnAssign):
             self.expr(node.value)
@@ -299,7 +445,10 @@ class _FnWalker(ast.NodeVisitor):
                     names = self._target_names(node.target)
                     if ci is not None and names:
                         self.calls[ci]["assigned"] = names
-            self._store_target(node.target)
+                self._extract_binding(node.target, node.value)
+            self._store_target(node.target,
+                               _self_attrs_in(node.value)
+                               if node.value is not None else None)
             return
         if isinstance(node, ast.Return):
             self.expr(node.value)
@@ -333,8 +482,13 @@ class _FnWalker(ast.NodeVisitor):
             self.events.append({"t": "ls"})
             self._loop += 1
             self.expr(node.test)
+            checked = sorted(_self_attrs_in(node.test))
+            if checked:
+                self._checks.append(checked)
             for s in node.body:
                 self.stmt(s)
+            if checked:
+                self._checks.pop()
             self._loop -= 1
             self.events.append({"t": "le"})
             for s in node.orelse:
@@ -342,10 +496,21 @@ class _FnWalker(ast.NodeVisitor):
             return
         if isinstance(node, ast.If):
             self.expr(node.test)
-            for s in node.body + node.orelse:
+            # a store to a self-attr the test just read is a
+            # check-then-act candidate; the orelse runs when the check
+            # failed, so only the body is bracketed
+            checked = sorted(_self_attrs_in(node.test))
+            if checked:
+                self._checks.append(checked)
+            for s in node.body:
+                self.stmt(s)
+            if checked:
+                self._checks.pop()
+            for s in node.orelse:
                 self.stmt(s)
             return
         if isinstance(node, (ast.With, ast.AsyncWith)):
+            pushed = 0
             for item in node.items:
                 self.expr(item.context_expr)
                 if item.optional_vars is not None:
@@ -354,8 +519,15 @@ class _FnWalker(ast.NodeVisitor):
                         if loads:
                             self.derives.append((name, loads))
                     self._store_target(item.optional_vars)
+                else:
+                    d = _dotted(item.context_expr)
+                    if d:               # `with self._lock:` holds a token
+                        self._held.append(d)
+                        pushed += 1
             for s in node.body:
                 self.stmt(s)
+            if pushed:
+                del self._held[-pushed:]
             return
         if isinstance(node, ast.Try):
             for s in node.body:
@@ -370,13 +542,49 @@ class _FnWalker(ast.NodeVisitor):
             for t in node.targets:
                 self._store_target(t)
             return
+        if isinstance(node, ast.Global):
+            for n in node.names:
+                if n not in self.globals:
+                    self.globals.append(n)
+            return
         # Expr / Assert / Raise / Global / Import / Pass / ...
         for child in ast.iter_child_nodes(node):
             if isinstance(child, ast.expr):
                 self.expr(child)
 
+    def _sync_make(self, token: str, kind: str, value: ast.Call) -> None:
+        rec: dict = {"token": token, "kind": kind, "line": value.lineno,
+                     "col": value.col_offset}
+        if kind == "queue":
+            bounded = False
+            if value.args and isinstance(value.args[0], ast.Constant) \
+                    and value.args[0].value:
+                bounded = True
+            for k in value.keywords:
+                if k.arg == "maxsize" and isinstance(k.value, ast.Constant) \
+                        and k.value.value:
+                    bounded = True
+            rec["bounded"] = bounded
+        elif kind == "pool":
+            for k in value.keywords:
+                if k.arg == "thread_name_prefix" \
+                        and isinstance(k.value, ast.Constant):
+                    rec["prefix"] = str(k.value.value)
+        self.sync_makes.append(rec)
+
     def _extract_binding(self, target: ast.AST, value: ast.AST) -> None:
-        """Callable aliases, donating dict entries, tuple binds."""
+        """Callable aliases, donating dict entries, tuple binds, and
+        sync-primitive constructions (lock/queue/pool/thread)."""
+        if isinstance(value, ast.Call):
+            mk = _last_name(value.func)
+            kind = _SYNC_MAKERS.get(mk) if mk else None
+            if kind is not None:
+                if isinstance(target, ast.Name):
+                    self._sync_make(target.id, kind, value)
+                elif isinstance(target, ast.Attribute):
+                    attr = _self_attr(target)
+                    if attr is not None:
+                        self._sync_make("self." + attr, kind, value)
         if isinstance(target, ast.Name):
             if isinstance(value, ast.Tuple):
                 self.tuple_binds[target.id] = [
@@ -600,6 +808,10 @@ def extract_module_summary(module: ModuleContext) -> dict:
             "key_assigns": key_assigns,
             "sampler_uses": sampler_uses,
             "sanitized": sanitized,
+            "spawns": walker.spawns,
+            "sync_makes": walker.sync_makes,
+            "joins": walker.joins,
+            "globals": walker.globals,
         }
 
     for node in ast.walk(tree):
@@ -637,6 +849,10 @@ def extract_module_summary(module: ModuleContext) -> dict:
         "key_assigns": mk,
         "sampler_uses": ms,
         "sanitized": msan,
+        "spawns": mod_walker.spawns,
+        "sync_makes": mod_walker.sync_makes,
+        "joins": mod_walker.joins,
+        "globals": mod_walker.globals,
     }
 
     summary = {
@@ -645,6 +861,7 @@ def extract_module_summary(module: ModuleContext) -> dict:
         "module_name": _module_name_of(module.path),
         "import_mods": import_mods,
         "import_syms": import_syms,
+        "jnp_aliases": sorted(index.jnp_aliases),
         "classes": classes,
         "functions": functions,
         "suppress": [[ln, sorted(ids)] for ln, ids in
@@ -1336,5 +1553,11 @@ FLOW_RULES: Tuple[Rule, ...] = (
     DiscardedPureResult(),
 )
 
-#: the full shipped rule set: lexical JG101-JG107 plus flow JG108-JG111
-ALL_RULES: Tuple[Rule, ...] = tuple(MODULE_RULES) + FLOW_RULES
+#: the full shipped rule set: lexical JG101-JG107, flow JG108-JG111,
+#: concurrency JG112-JG116.  threads.py imports Program/summaries from
+#: this module, so the thread rules are pulled in at the bottom — every
+#: name they need is already bound by the time this import runs.
+from .threads import THREAD_RULES  # noqa: E402  (deliberate late import)
+
+ALL_RULES: Tuple[Rule, ...] = (tuple(MODULE_RULES) + FLOW_RULES
+                               + THREAD_RULES)

@@ -32,8 +32,8 @@ from typing import Dict, List, Optional, Sequence, Set
 from .core import (Finding, LintEngine, LintResult, ModuleContext, Severity,
                    expand_paths, load_baseline, norm_path, render_json,
                    render_sarif, render_text, save_baseline)
-from .flow import (ALL_RULES, SUMMARY_VERSION, extract_module_summary,
-                   file_sha1, strip_summary)
+from .flow import (ALL_RULES, ANALYSIS_VERSION, SUMMARY_VERSION,
+                   extract_module_summary, file_sha1, strip_summary)
 
 CACHE_VERSION = 1
 
@@ -103,6 +103,12 @@ def _load_cache(path: Optional[Path]) -> Dict[str, dict]:
         return {}
     if data.get("version") != CACHE_VERSION:
         return {}
+    # a sha1 match alone is not enough: editing extraction or rule
+    # logic changes what a summary *means* without changing the file it
+    # came from, so entries written by a different analysis generation
+    # are discarded wholesale (the staleness hole fixed in PR 9)
+    if data.get("analysis_version") != ANALYSIS_VERSION:
+        return {}
     entries = data.get("summaries")
     return entries if isinstance(entries, dict) else {}
 
@@ -110,7 +116,8 @@ def _load_cache(path: Optional[Path]) -> Dict[str, dict]:
 def _save_cache(path: Path, entries: Dict[str, dict]) -> None:
     path.parent.mkdir(parents=True, exist_ok=True)
     path.write_text(json.dumps(
-        {"version": CACHE_VERSION, "summaries": entries},
+        {"version": CACHE_VERSION, "analysis_version": ANALYSIS_VERSION,
+         "summaries": entries},
         sort_keys=True) + "\n")
 
 

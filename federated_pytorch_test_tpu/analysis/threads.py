@@ -1,0 +1,581 @@
+"""Concurrency graftcheck: thread-role inference + host-race rules.
+
+PRs 5-8 made the host side genuinely concurrent — the async checkpoint
+writer runs a one-worker pool, the LOFAR pipeline runs a bounded-queue
+prefetch thread, the engine stages epochs on a worker — so this module
+polices host-concurrency bugs the way flow.py polices donation bugs:
+statically, whole-program, zero findings baselined.
+
+**Role inference.**  Every ``threading.Thread(target=...)`` constructor
+and every ``<pool>.submit(fn, ...)`` on a known ``ThreadPoolExecutor``
+is a *spawn edge*; its target function is seeded with a role named
+after the thread ``name=``, the pool's ``thread_name_prefix``, or the
+target function itself (``_produce`` -> ``produce``).  Spawned roles
+propagate over resolved call edges, but only through *unambiguous*
+resolutions — an untyped ``obj.meth(...)`` that unions into several
+classes would smear a worker role across unrelated code, so multi-
+candidate edges stop spawned-role flow.  The ``main`` role starts at
+every module body and every function with no incoming call or spawn
+edge (public API, drivers) and propagates through every edge including
+unions: over-approximating *main* is harmless (it is the safe role),
+over-approximating a *worker* role would manufacture races.
+
+A function reachable both ways (``save_checkpoint_swapped``: called
+synchronously by the engine and submitted to the ckpt-writer pool)
+carries both roles.  Construction-time writes (``__init__`` and
+friends) are excluded from the race rules: publish-before-spawn is the
+idiom the whole tree uses.
+
+**Rules.**
+
+- **JG112** (WARNING) — a shared mutable attribute (``self.x`` /
+  ``global x``) written under >= 2 thread roles with no common lock
+  held across all write sites.  Attributes that *are* synchronisation
+  objects (locks, queues, events, pools, thread handles) are exempt:
+  they synchronise themselves.
+- **JG113** (WARNING) — a blocking call (queue get/put, ``join``,
+  ``result``, ``wait``, file I/O, ``time.sleep``,
+  ``block_until_ready``, cross-host barrier) or a JAX dispatch issued
+  while holding a lock: the lock's critical section inherits the full
+  latency and every other thread convoys behind it.
+- **JG114** (WARNING) — non-atomic check-then-act (``if k in
+  self._d: ... self._d[k] = ...``) or read-modify-write
+  (``self._round += 1``) on state accessed under >= 2 roles, with no
+  lock held at the mutating site.
+- **JG115** (ERROR) — JAX device computation (``jnp.*`` /
+  ``jax.lax.*`` / ``jax.random.*`` samplers / ``device_put`` / a call
+  resolving into a jitted function) reachable under a non-main thread
+  role — the bug class ``snapshot_to_host`` exists to prevent: the
+  runtime's dispatch path is not thread-safe against the main round
+  loop.  Host-only jax calls (``jax.process_index``, ``jax.tree.*``,
+  ``device_get``) are deliberately not dispatch.
+- **JG116** (WARNING) — lifecycle: a thread/pool stored on an
+  attribute with no reachable ``join``/``shutdown`` anywhere in the
+  program, a local thread neither joined nor returned, a thread
+  spawned without keeping a handle at all, and an unbounded
+  ``queue.Queue`` that receives puts (the producer can outrun the
+  consumer without backpressure).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, ProgramRule, Severity
+from .flow import Program, _label, _mk_finding, _program_of
+
+MAIN_ROLE = "main"
+
+#: construction-time functions whose attribute writes are
+#: publish-before-spawn, not races
+_INIT_NAMES = {"__init__", "__new__", "__post_init__"}
+
+#: blocking call tails (resolved against the callee's dotted name)
+_BLOCKING_TAILS = {
+    "join": "blocks on a thread/process join",
+    "result": "blocks on a future result",
+    "wait": "blocks on an event/condition/future wait",
+    "block_until_ready": "blocks on a device computation",
+    "sync_global_devices": "blocks on a cross-host barrier",
+}
+
+
+def _short_name(fn: dict) -> str:
+    return fn["qual"].rsplit(".", 1)[-1]
+
+
+def _fn_key(fn: dict) -> Tuple[str, str]:
+    return (fn["_path"], fn["qual"])
+
+
+def _token_attr(token: str) -> str:
+    """``self._lock`` -> ``_lock``; bare locals pass through."""
+    return token.rsplit(".", 1)[-1]
+
+
+class ThreadModel:
+    """Program-wide concurrency facts: sync-object attributes, spawn
+    edges, and the role set of every function."""
+
+    def __init__(self, prog: Program):
+        self.prog = prog
+        #: attr/local name -> sync kind ("lock"/"queue"/"pool"/...)
+        self.sync_attr_kinds: Dict[str, str] = {}
+        #: (owner class or "", attr) -> make record
+        self.sync_makes: Dict[Tuple[str, str], dict] = {}
+        #: names known to be Lock/RLock objects
+        self.lock_names: Set[str] = set()
+        #: (path, qual) -> set of role names
+        self.roles: Dict[Tuple[str, str], Set[str]] = {}
+        #: role name -> human label of the spawn site that created it
+        self.role_sources: Dict[str, str] = {}
+        #: spawn edges as (spawning fn, spawn record, role, targets)
+        self.spawn_edges: List[Tuple[dict, dict, str, List[dict]]] = []
+        self._succ_cache: Dict[Tuple[str, str], List[List[dict]]] = {}
+        self._collect_sync()
+        self._collect_spawns()
+        self._propagate()
+
+    # ------------------------------------------------------------ build
+
+    def _collect_sync(self) -> None:
+        for fn in self.prog.all_fns():
+            for m in fn["sync_makes"]:
+                token = m["token"]
+                attr = _token_attr(token)
+                owner = fn["cls"] or ""
+                self.sync_makes[(owner, attr)] = m
+                self.sync_attr_kinds[attr] = m["kind"]
+                if m["kind"] == "lock":
+                    self.lock_names.add(attr)
+
+    def _role_name(self, fn: dict, spawn: dict) -> str:
+        if spawn.get("name"):
+            return spawn["name"]
+        if spawn["via"] == "submit" and spawn.get("pool"):
+            attr = _token_attr(spawn["pool"])
+            make = (self.sync_makes.get((fn["cls"] or "", attr))
+                    or self.sync_makes.get(("", attr)))
+            if make is None:        # any class owning a pool by this name
+                for (_owner, a), m in self.sync_makes.items():
+                    if a == attr and m["kind"] == "pool":
+                        make = m
+                        break
+            if make is not None and make.get("prefix"):
+                return make["prefix"]
+        ref = spawn["target"]
+        while isinstance(ref, dict) and ref.get("k") == "wrap":
+            ref = ref["v"]
+        if isinstance(ref, dict) and ref.get("k") == "dotted":
+            return _token_attr(ref["v"]).strip("_") or "worker"
+        return f"worker@{spawn['line']}"
+
+    def _is_known_pool(self, fn: dict, base: Optional[str]) -> bool:
+        if not base:
+            return False
+        return self.sync_attr_kinds.get(_token_attr(base)) == "pool"
+
+    def _collect_spawns(self) -> None:
+        for fn in self.prog.all_fns():
+            for spawn in fn["spawns"]:
+                if spawn["via"] == "submit" \
+                        and not self._is_known_pool(fn, spawn.get("pool")):
+                    continue        # .submit on something that is no pool
+                role = self._role_name(fn, spawn)
+                targets = [t.fn for t in
+                           self.prog.resolve(fn, spawn["target"])]
+                self.role_sources.setdefault(
+                    role, f"{_label(fn)}:{spawn['line']}")
+                self.spawn_edges.append((fn, spawn, role, targets))
+
+    def _successors(self, fn: dict) -> List[List[dict]]:
+        """Resolved callees per call site (each inner list is the
+        candidate set of one call)."""
+        key = _fn_key(fn)
+        got = self._succ_cache.get(key)
+        if got is None:
+            got = [[t.fn for t in self.prog.resolve(fn, call["callee"])]
+                   for call in fn["calls"]]
+            self._succ_cache[key] = got
+        return got
+
+    def _propagate(self) -> None:
+        work: deque = deque()
+
+        def add(fn: dict, role: str) -> None:
+            have = self.roles.setdefault(_fn_key(fn), set())
+            if role not in have:
+                have.add(role)
+                work.append((fn, role))
+
+        spawn_targets: Set[Tuple[str, str]] = set()
+        for _fn, _spawn, role, targets in self.spawn_edges:
+            for t in targets:
+                spawn_targets.add(_fn_key(t))
+                add(t, role)
+        # spawned roles flow only through unambiguous call edges
+        while work:
+            fn, role = work.popleft()
+            for candidates in self._successors(fn):
+                if len(candidates) == 1:
+                    add(candidates[0], role)
+
+        has_in: Set[Tuple[str, str]] = set(spawn_targets)
+        for fn in self.prog.all_fns():
+            for candidates in self._successors(fn):
+                for callee in candidates:
+                    if callee is not fn:
+                        has_in.add(_fn_key(callee))
+        for fn in self.prog.all_fns():
+            if fn["qual"] == "<module>" or _fn_key(fn) not in has_in:
+                add(fn, MAIN_ROLE)
+        # main propagates through every edge, unions included
+        while work:
+            fn, role = work.popleft()
+            for candidates in self._successors(fn):
+                for callee in candidates:
+                    add(callee, role)
+
+    # ---------------------------------------------------------- queries
+
+    def roles_of(self, fn: dict) -> Set[str]:
+        return self.roles.get(_fn_key(fn), set())
+
+    def worker_roles_of(self, fn: dict) -> Set[str]:
+        return self.roles_of(fn) - {MAIN_ROLE}
+
+    def held_locks(self, tokens: Sequence[str]) -> Set[str]:
+        """The subset of held ``with``/``acquire`` tokens that are known
+        Lock/RLock objects."""
+        return {t for t in tokens if _token_attr(t) in self.lock_names}
+
+    def is_sync_attr(self, attr: str) -> bool:
+        return attr in self.sync_attr_kinds
+
+    def shared_accesses(self) -> Dict[Tuple[str, str],
+                                      List[Tuple[dict, dict]]]:
+        """(owner, attr) -> [(fn, event)] over every ``self.X`` access
+        and every ``global``-declared name (owner = ``<module name>``)."""
+        out: Dict[Tuple[str, str], List[Tuple[dict, dict]]] = {}
+        for fn in self.prog.all_fns():
+            if fn["cls"]:
+                for ev in fn["events"]:
+                    if ev["t"] in ("aload", "astore"):
+                        out.setdefault((fn["cls"], ev["n"]),
+                                       []).append((fn, ev))
+            g = set(fn["globals"])
+            if g:
+                owner = fn["_mod"]["module_name"]
+                for ev in fn["events"]:
+                    if ev["t"] == "store" and ev["n"] in g:
+                        sev = {"t": "astore", "n": ev["n"],
+                               "line": fn["line"], "col": 0}
+                        out.setdefault((owner, ev["n"]),
+                                       []).append((fn, sev))
+                    elif ev["t"] == "load" and ev["n"] in g:
+                        out.setdefault((owner, ev["n"]),
+                                       []).append((fn, ev))
+        return out
+
+    # ------------------------------------------------- dispatch classing
+
+    def dispatch_desc(self, fn: dict, call: dict) -> Optional[str]:
+        """Why this call is a JAX device dispatch, or None."""
+        ref = call["callee"]
+        while isinstance(ref, dict) and ref.get("k") == "wrap":
+            ref = ref["v"]
+        if isinstance(ref, dict) and ref.get("k") == "dotted":
+            d = ref["v"]
+            head = d.split(".")[0]
+            if head in fn["_mod"].get("jnp_aliases", []) \
+                    or d.startswith("jax.numpy."):
+                return f"{d}() device op"
+            if d.startswith(("jax.lax.", "lax.")):
+                return f"{d}() lax op"
+            if d.startswith("jax.random."):
+                return f"{d}() sampler"
+            if d in ("jax.device_put", "device_put"):
+                return f"{d}() transfer"
+        for target in self.prog.resolve(fn, call["callee"]):
+            if target.fn["jit_root"] or target.fn["in_jit"]:
+                return f"call into jitted {_label(target.fn)!r}"
+        return None
+
+    def blocking_desc(self, fn: dict, call: dict) -> Optional[str]:
+        """Why this call blocks the current thread, or None."""
+        ref = call["callee"]
+        if not (isinstance(ref, dict) and ref.get("k") == "dotted"):
+            return None
+        d = ref["v"]
+        base, _, last = d.rpartition(".")
+        if last in _BLOCKING_TAILS and (base or last in (
+                "block_until_ready", "sync_global_devices")):
+            return _BLOCKING_TAILS[last]
+        if d == "open":
+            return "performs file I/O (open)"
+        if d == "time.sleep" or d.endswith(".sleep"):
+            return "sleeps"
+        if last in ("get", "put") and base \
+                and self.sync_attr_kinds.get(_token_attr(base)) == "queue":
+            return f"blocks on queue .{last}()"
+        return None
+
+
+def _model_of(prog: Program, state: dict) -> ThreadModel:
+    model = state.get("thread_model")
+    if model is None or model.prog is not prog:
+        model = ThreadModel(prog)
+        state["thread_model"] = model
+    return model
+
+
+def build_thread_model(prog: Program) -> ThreadModel:
+    """Public entry for tests: infer roles over an existing Program."""
+    return ThreadModel(prog)
+
+
+def _roles_str(roles: Set[str]) -> str:
+    return "{" + ", ".join(sorted(roles)) + "}"
+
+
+# ================================================================ JG112
+
+class SharedWriteNoLock(ProgramRule):
+    """A slot written under two different thread roles is a data race
+    unless every write site holds one common lock."""
+
+    id = "JG112"
+    severity = Severity.WARNING
+    summary = "shared attribute written under >=2 thread roles, no lock"
+
+    def check_program(self, modules, extra_summaries, state
+                      ) -> Iterator[Finding]:
+        prog, live = _program_of(modules, extra_summaries, state)
+        model = _model_of(prog, state)
+        for (owner, attr), sites in sorted(model.shared_accesses().items()):
+            if model.is_sync_attr(attr):
+                continue
+            writes = [(fn, ev) for fn, ev in sites
+                      if ev["t"] == "astore"
+                      and _short_name(fn) not in _INIT_NAMES]
+            if not writes:
+                continue
+            role_union: Set[str] = set()
+            for fn, _ev in writes:
+                role_union |= model.roles_of(fn)
+            if len(role_union) < 2:
+                continue
+            guards = [model.held_locks(ev.get("h", ()))
+                      for _fn, ev in writes]
+            if set.intersection(*guards):
+                continue
+            writers = sorted({_label(fn) for fn, _ev in writes})
+            for fn, ev in writes:
+                if fn["_path"] not in live:
+                    continue
+                yield _mk_finding(
+                    self, live, fn["_path"], ev["line"], ev["col"],
+                    f"{owner}.{attr!s} is written under thread roles "
+                    f"{_roles_str(role_union)} with no common lock held "
+                    "across the write sites — concurrent writers race; "
+                    "guard every access with one threading.Lock (or "
+                    "confine the slot to a single role)",
+                    chain=writers)
+                break               # one finding per slot
+
+
+# ================================================================ JG113
+
+class BlockingUnderLock(ProgramRule):
+    """Blocking (or dispatching to the device) while holding a lock
+    serialises every thread that wants the lock behind the slow call."""
+
+    id = "JG113"
+    severity = Severity.WARNING
+    summary = "blocking call or JAX dispatch while holding a lock"
+
+    def check_program(self, modules, extra_summaries, state
+                      ) -> Iterator[Finding]:
+        prog, live = _program_of(modules, extra_summaries, state)
+        model = _model_of(prog, state)
+        for fn in prog.all_fns():
+            if fn["_path"] not in live:
+                continue
+            for call in fn["calls"]:
+                held = sorted(model.held_locks(call.get("held", ())))
+                if not held:
+                    continue
+                why = model.blocking_desc(fn, call)
+                if why is None:
+                    why = model.dispatch_desc(fn, call)
+                    if why is not None:
+                        why = f"dispatches to the device ({why})"
+                if why is None:
+                    continue
+                yield _mk_finding(
+                    self, live, fn["_path"], call["line"], call["col"],
+                    f"this call {why} while holding "
+                    f"{', '.join(held)} — the critical section inherits "
+                    "the full wait and other threads convoy on the "
+                    "lock; move the slow call outside the lock and "
+                    "only publish the result under it",
+                    chain=[_label(fn)])
+
+
+# ================================================================ JG114
+
+class CheckThenAct(ProgramRule):
+    """``if <reads self.x>: self.x = ...`` and ``self.x += 1`` are
+    atomic only single-threaded; under two roles the interleaving
+    between check/read and act/write loses updates."""
+
+    id = "JG114"
+    severity = Severity.WARNING
+    summary = "non-atomic check-then-act / read-modify-write across roles"
+
+    def check_program(self, modules, extra_summaries, state
+                      ) -> Iterator[Finding]:
+        prog, live = _program_of(modules, extra_summaries, state)
+        model = _model_of(prog, state)
+        for (owner, attr), sites in sorted(model.shared_accesses().items()):
+            if model.is_sync_attr(attr):
+                continue
+            active = [(fn, ev) for fn, ev in sites
+                      if _short_name(fn) not in _INIT_NAMES]
+            if not any(ev["t"] == "astore" for _fn, ev in active):
+                continue
+            role_union: Set[str] = set()
+            for fn, _ev in active:
+                role_union |= model.roles_of(fn)
+            if len(role_union) < 2:
+                continue
+            for fn, ev in active:
+                if ev["t"] != "astore" or fn["_path"] not in live:
+                    continue
+                rmw = bool(ev.get("rmw"))
+                checked = attr in ev.get("chk", ())
+                if not (rmw or checked):
+                    continue
+                if model.held_locks(ev.get("h", ())):
+                    continue
+                shape = ("read-modify-write" if rmw
+                         else "check-then-act (tested by the enclosing "
+                              "if/while)")
+                yield _mk_finding(
+                    self, live, fn["_path"], ev["line"], ev["col"],
+                    f"non-atomic {shape} on {owner}.{attr!s}, which is "
+                    f"accessed under thread roles "
+                    f"{_roles_str(role_union)} — another role can "
+                    "interleave between the read/test and this write; "
+                    "hold a lock across the whole sequence",
+                    chain=[_label(fn)])
+
+
+# ================================================================ JG115
+
+class ThreadedJaxDispatch(ProgramRule):
+    """JAX dispatch is only safe from the thread that owns the runtime
+    (the main round loop); a worker role that traces/launches device
+    work races the engine's own dispatch — snapshot on the main thread
+    (``snapshot_to_host``) and hand workers plain host arrays."""
+
+    id = "JG115"
+    severity = Severity.ERROR
+    summary = "JAX device dispatch reachable from a non-main thread role"
+
+    def check_program(self, modules, extra_summaries, state
+                      ) -> Iterator[Finding]:
+        prog, live = _program_of(modules, extra_summaries, state)
+        model = _model_of(prog, state)
+        for fn in prog.all_fns():
+            if fn["_path"] not in live:
+                continue
+            workers = model.worker_roles_of(fn)
+            if not workers:
+                continue
+            for call in fn["calls"]:
+                desc = model.dispatch_desc(fn, call)
+                if desc is None:
+                    continue
+                chain = [model.role_sources.get(r, r)
+                         for r in sorted(workers)]
+                yield _mk_finding(
+                    self, live, fn["_path"], call["line"], call["col"],
+                    f"{desc} runs under worker thread role(s) "
+                    f"{_roles_str(workers)} — device dispatch off the "
+                    "main thread races the round loop's own launches; "
+                    "materialise on the main thread (snapshot_to_host) "
+                    "and pass host arrays to the worker",
+                    chain=chain)
+
+
+# ================================================================ JG116
+
+class ThreadLifecycle(ProgramRule):
+    """Threads/pools must have a reachable join/shutdown (otherwise
+    exit and abort paths leak workers mid-write), and producer queues
+    must be bounded (otherwise a fast producer buffers without limit)."""
+
+    id = "JG116"
+    severity = Severity.WARNING
+    summary = "thread/pool without join/shutdown, or unbounded queue puts"
+
+    def check_program(self, modules, extra_summaries, state
+                      ) -> Iterator[Finding]:
+        prog, live = _program_of(modules, extra_summaries, state)
+        model = _model_of(prog, state)
+        join_tokens: Set[str] = set()
+        put_bases: Set[str] = set()
+        for fn in prog.all_fns():
+            for j in fn["joins"]:
+                join_tokens.add(j["token"])
+                join_tokens.add(_token_attr(j["token"]))
+            for call in fn["calls"]:
+                ref = call["callee"]
+                if isinstance(ref, dict) and ref.get("k") == "dotted":
+                    base, _, last = ref["v"].rpartition(".")
+                    if last in ("put", "put_nowait") and base:
+                        put_bases.add(_token_attr(base))
+        for fn in prog.all_fns():
+            if fn["_path"] not in live:
+                continue
+            returned = {elt.get("v") for ret in fn["returns"]
+                        for elt in ret if elt.get("k") == "name"}
+            for m in fn["sync_makes"]:
+                token, kind = m["token"], m["kind"]
+                if kind in ("thread", "pool"):
+                    what = ("thread" if kind == "thread" else
+                            "executor pool")
+                    verb = "join()" if kind == "thread" else "shutdown()"
+                    if token.startswith("self."):
+                        if token in join_tokens \
+                                or _token_attr(token) in join_tokens:
+                            continue
+                    else:
+                        if any(j["token"] == token for j in fn["joins"]) \
+                                or token in returned:
+                            continue
+                    yield _mk_finding(
+                        self, live, fn["_path"], m["line"], m["col"],
+                        f"{token} holds a {what} with no reachable "
+                        f"{verb} anywhere in the program — exit and "
+                        "abort paths leak the worker mid-write; retire "
+                        f"it with {verb} on every path (a close()/"
+                        "finally block)",
+                        chain=[_label(fn)])
+                elif kind == "queue" and not m.get("bounded", True):
+                    attr = _token_attr(token)
+                    if attr in put_bases:
+                        yield _mk_finding(
+                            self, live, fn["_path"], m["line"], m["col"],
+                            f"{token} is an unbounded queue that "
+                            "receives puts — a producer that outruns "
+                            "its consumer buffers without limit; "
+                            "construct it with maxsize= to get "
+                            "backpressure",
+                            chain=[_label(fn)])
+            # fire-and-forget: a Thread(...) spawned without binding
+            # any handle cannot be joined at all
+            make_lines = {m["line"] for m in fn["sync_makes"]
+                          if m["kind"] == "thread"}
+            for spawn in fn["spawns"]:
+                if spawn["via"] == "thread" \
+                        and spawn["line"] not in make_lines:
+                    yield _mk_finding(
+                        self, live, fn["_path"], spawn["line"],
+                        spawn["col"],
+                        "thread spawned without keeping a handle — it "
+                        "can never be joined, so program exit races "
+                        "whatever it is doing; bind it and join on the "
+                        "shutdown path",
+                        chain=[_label(fn)])
+
+
+THREAD_RULES: Tuple[ProgramRule, ...] = (
+    SharedWriteNoLock(),
+    BlockingUnderLock(),
+    CheckThenAct(),
+    ThreadedJaxDispatch(),
+    ThreadLifecycle(),
+)

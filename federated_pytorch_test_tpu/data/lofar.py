@@ -134,6 +134,12 @@ class CPCDataSource:
         self.patch_size = patch_size
         self.seed = seed
         self._rng = np.random.default_rng(seed)
+        # guards the round counter: round_batches runs both on the
+        # caller's thread (direct path) and on a RoundPrefetcher
+        # producer.  The lock only sequences counter bumps — every draw
+        # is keyed on (seed, round, client), so locking cannot change
+        # any sampled value (PARITY.md: bit-identical math path).
+        self._lock = threading.Lock()
         self._round = 0
 
     @property
@@ -160,8 +166,9 @@ class CPCDataSource:
         same draw sequence starting at its first client.
         """
         clients = range(self.K) if clients is None else clients
-        rnd = self._round
-        self._round += 1
+        with self._lock:
+            rnd = self._round
+            self._round += 1
         out = []
         px = py = None
         for ck in clients:
@@ -226,7 +233,8 @@ class RoundPrefetcher:
 
         Joins the thread: it exits within one put-poll (~0.2s) of finishing
         any in-flight ``round_batches`` build, and joining guarantees no
-        producer is still advancing the source's (unsynchronised) round
-        counter when the caller reuses the CPCDataSource."""
+        producer is still advancing the source's round counter (locked,
+        but a straggler bump would still skew which rounds the direct
+        path sees) when the caller reuses the CPCDataSource."""
         self._stop = True
         self._thread.join()

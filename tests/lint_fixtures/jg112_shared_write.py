@@ -1,0 +1,24 @@
+"""Fixture: triggers exactly JG112 (shared attribute written under two
+thread roles with no common lock).
+
+``status`` is written by the spawned ``_run`` (role ``run``) and by
+``stop`` (main role).  The ``__init__`` publication write is excluded
+by design (publish-before-spawn), the thread IS joined (JG116 quiet),
+the writes are plain stores (no read-modify-write or check-then-act,
+JG114 quiet), and nothing blocks under a lock (JG113 quiet).
+"""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self.status = "idle"
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        self.status = "running"
+
+    def stop(self):
+        self.status = "stopped"
+        self._thread.join()

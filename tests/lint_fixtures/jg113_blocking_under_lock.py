@@ -1,0 +1,23 @@
+"""Fixture: triggers exactly JG113 (blocking call while holding a lock).
+
+``flush`` performs file I/O inside ``with self._lock:`` — every other
+thread that wants the lock convoys behind the disk write.  No second
+thread role exists here (JG112/JG114/JG115 need roles; JG116 needs a
+thread/pool/queue), so only JG113 fires.
+"""
+import threading
+
+
+class Journal:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = []
+
+    def add(self, row):
+        with self._lock:
+            self._rows.append(row)
+
+    def flush(self, path):
+        with self._lock:
+            with open(path, "w") as f:
+                f.write("\n".join(self._rows))

@@ -1,0 +1,29 @@
+"""Fixture: triggers exactly JG114 (non-atomic check-then-act across
+thread roles).
+
+``ensure`` tests ``key not in self._slots`` and then stores into the
+dict — while the spawned ``_refresh`` role reads the same dict, so the
+membership test can be invalidated between check and act.  Only ONE
+role ever writes (main), so JG112 (>= 2 *writing* roles) stays quiet;
+the thread is joined (JG116 quiet); there are no locks (JG113 quiet).
+"""
+import threading
+
+
+class SlotCache:
+    def __init__(self):
+        self._slots = {}
+        self._thread = threading.Thread(target=self._refresh, daemon=True)
+        self._thread.start()
+
+    def _refresh(self):
+        for key in list(self._slots):
+            print(key, self._slots[key])
+
+    def ensure(self, key, build):
+        if key not in self._slots:
+            self._slots[key] = build()
+        return self._slots[key]
+
+    def stop(self):
+        self._thread.join()

@@ -1,0 +1,29 @@
+"""Fixture: triggers exactly JG115 (JAX dispatch under a worker role).
+
+The spawned ``_report`` role calls ``device_norm``, whose ``jnp`` ops
+dispatch to the device off the main thread — the finding anchors at
+the dispatch site reached THROUGH the call edge, proving role
+propagation.  The thread is joined (JG116 quiet); no shared attribute
+is written outside ``__init__`` (JG112/JG114 quiet); no locks exist
+(JG113 quiet); every jnp result is used (JG111 quiet).
+"""
+import threading
+
+import jax.numpy as jnp
+
+
+def device_norm(x):
+    return jnp.sqrt(jnp.sum(x * x))
+
+
+class Reporter:
+    def __init__(self, x):
+        self._thread = threading.Thread(
+            target=self._report, args=(x,), daemon=True)
+        self._thread.start()
+
+    def _report(self, x):
+        print(device_norm(x))
+
+    def stop(self):
+        self._thread.join()

@@ -1,0 +1,25 @@
+"""Fixture: triggers exactly JG116 (thread lifecycle), twice.
+
+``_thread`` is spawned but no ``join()`` exists anywhere in the
+program, and ``_q`` is an unbounded queue that receives puts.  ``_q``
+is a synchronisation object, so JG112/JG114 stay quiet about it; the
+worker only drains the queue (queue ops are exempt, and no lock is
+held: JG113 quiet); nothing touches JAX (JG115 quiet).
+"""
+import queue
+import threading
+
+
+class FireAndForget:
+    def __init__(self):
+        self._q = queue.Queue()
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def _drain(self):
+        while True:
+            item = self._q.get()
+            print(item)
+
+    def push(self, item):
+        self._q.put(item)

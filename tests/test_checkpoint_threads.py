@@ -1,0 +1,130 @@
+"""Runtime counterpart of the JG112-JG116 static pass: stress the two
+shipped thread lifecycles the linter reasons about.
+
+* :class:`AsyncCheckpointWriter` — a burst of submits racing the
+  ``ckpt-writer`` worker's slot rotation must end (after the ``close()``
+  drain) with every surviving swap slot checksum-clean and the newest
+  slot holding exactly the LAST submitted tree: the submission queue is
+  the rotation barrier, so no save may be lost, torn, or reordered.
+* :class:`RoundPrefetcher` — repeated start/consume/close cycles must
+  never leak producer threads, and the (PR-9) source lock must keep the
+  shared round counter exact under cross-thread bumps.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from federated_pytorch_test_tpu.data.lofar import (
+    CPCDataSource,
+    RoundPrefetcher,
+)
+from federated_pytorch_test_tpu.utils.checkpoint import (
+    AsyncCheckpointWriter,
+    checkpoint_slots,
+    load_checkpoint,
+    newest_slot,
+    verify_checkpoint,
+)
+
+pytestmark = [pytest.mark.slow, pytest.mark.lintthreads]
+
+
+class TestAsyncWriterStress:
+    def test_submit_burst_drains_without_loss_or_corruption(self, tmp_path):
+        ck = str(tmp_path / "ck")
+        writer = AsyncCheckpointWriter(max_pending=2)
+        n = 10
+        try:
+            for v in range(n):
+                tree = {"v": np.asarray(v),
+                        "w": np.full((8, 8), float(v), np.float32)}
+                writer.submit(ck, tree, meta={"round": v})
+                if v == n // 2:
+                    # mid-burst barrier: interleaving wait() with the
+                    # worker's rotation must not drop queued saves
+                    writer.wait()
+        finally:
+            writer.close()
+        # exit drain: every surviving swap slot is checksum-complete
+        slots = checkpoint_slots(ck)
+        assert slots
+        for slot in slots:
+            assert verify_checkpoint(slot)
+        # strict ordering: the newest slot is exactly the last submit
+        restored, meta = load_checkpoint(newest_slot(ck))
+        assert int(restored["v"]) == n - 1
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]),
+            np.full((8, 8), float(n - 1), np.float32))
+        assert int(meta["round"]) == n - 1
+
+    def test_close_is_idempotent_and_fences_submit(self, tmp_path):
+        ck = str(tmp_path / "ck")
+        writer = AsyncCheckpointWriter()
+        writer.submit(ck, {"v": np.asarray(1)})
+        writer.close()
+        writer.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            writer.submit(ck, {"v": np.asarray(2)})
+        assert verify_checkpoint(newest_slot(ck))
+
+    def test_background_failure_surfaces_at_the_barrier(
+            self, tmp_path, monkeypatch):
+        import federated_pytorch_test_tpu.utils.checkpoint as ckpt
+
+        def boom(path, tree, meta=None):
+            raise OSError("disk on fire")
+
+        monkeypatch.setattr(ckpt, "save_checkpoint_swapped", boom)
+        writer = AsyncCheckpointWriter()
+        writer.submit(str(tmp_path / "ck"), {"v": np.asarray(1)})
+        with pytest.raises(OSError, match="disk on fire"):
+            writer.wait()
+        writer.close()          # already-drained close stays clean
+
+
+class TestPrefetcherLifecycle:
+    def _source(self, seed=7):
+        return CPCDataSource(["a.h5", "b.h5"], ["0", "1"],
+                             batch_size=2, seed=seed)
+
+    def test_start_stop_loop_never_leaks_threads(self):
+        src = self._source()
+        # warm-up cycle so lazily-started runtime threads (if any) are
+        # in the baseline count
+        RoundPrefetcher(src, niter=1, total_rounds=2).close()
+        baseline = threading.active_count()
+        for i in range(10):
+            pre = RoundPrefetcher(src, niter=1, total_rounds=50)
+            if i % 2:
+                pre.get()       # sometimes consume before closing
+            pre.close()
+            assert not pre._thread.is_alive()
+        assert threading.active_count() == baseline
+
+    def test_close_mid_production_unblocks_the_producer(self):
+        # total_rounds far beyond what is consumed: the producer parks
+        # in the bounded put; close() must still join promptly
+        pre = RoundPrefetcher(self._source(), niter=1, total_rounds=10_000)
+        pre.get()
+        pre.close()
+        assert not pre._thread.is_alive()
+
+    def test_round_counter_is_exact_under_cross_thread_bumps(self):
+        """The PR-9 lock: round_batches runs on both the caller thread
+        and prefetch producers; the counter must count every call."""
+        src = self._source(seed=1)
+        per_thread, threads = 20, 4
+
+        def hammer():
+            for _ in range(per_thread):
+                src.round_batches(1, clients=[0])
+
+        workers = [threading.Thread(target=hammer) for _ in range(threads)]
+        for t in workers:
+            t.start()
+        for t in workers:
+            t.join()
+        assert src._round == per_thread * threads

@@ -89,11 +89,22 @@ class TestGraftcheckClean:
             assert cache.exists()
 
     def test_flow_rules_active_in_gate(self):
-        """The clean gate is not vacuous for the interprocedural layer:
-        ALL_RULES must include JG108-JG111 (so the assertions above ran
-        them over the tree)."""
+        """The clean gate is not vacuous for the interprocedural and
+        concurrency layers: ALL_RULES must carry the full JG101-JG116
+        set (so the assertions above ran all sixteen over the tree)."""
         ids = {r.id for r in ALL_RULES}
-        assert {"JG108", "JG109", "JG110", "JG111"} <= ids
+        assert {f"JG{n}" for n in range(101, 117)} <= ids
+
+    def test_jg115_is_error_severity(self):
+        """--fail-on error still gates threaded JAX dispatch: JG115 is
+        the one concurrency rule promoted to ERROR (a host race warps
+        timing; dispatching from a worker thread deadlocks or corrupts
+        the dispatch stream outright)."""
+        from federated_pytorch_test_tpu.analysis.threads import (
+            ThreadedJaxDispatch,
+        )
+
+        assert ThreadedJaxDispatch.severity is Severity.ERROR
 
     def test_jg106_is_warning_and_tree_has_none(self):
         """JG106 (donation) was promoted from advice to WARNING once the

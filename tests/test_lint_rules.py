@@ -30,6 +30,11 @@ CASES = [
     ("jg109_use_after_donate.py", "JG109"),
     ("jg110_key_lineage.py", "JG110"),
     ("jg111_discarded_pure.py", "JG111"),
+    ("jg112_shared_write.py", "JG112"),
+    ("jg113_blocking_under_lock.py", "JG113"),
+    ("jg114_check_then_act.py", "JG114"),
+    ("jg115_jit_from_thread.py", "JG115"),
+    ("jg116_lifecycle.py", "JG116"),
 ]
 
 

@@ -1,0 +1,467 @@
+"""Concurrency graftcheck (analysis/threads.py): fixture coverage for
+JG112-JG116, thread-role inference units (pool-submit and the
+recorder->watchdog tap), guarded-vs-unguarded non-vacuity pairs,
+machine-readable output of the new rule metadata, and the
+``--cache`` analysis-version staleness regression.
+
+Each fixture file under ``lint_fixtures/`` must trip EXACTLY its own
+rule — the fixtures double as the non-overlap contract between the
+five rules.
+"""
+
+import ast
+import json
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from federated_pytorch_test_tpu.analysis.core import (
+    LintEngine,
+    ModuleContext,
+    Severity,
+)
+from federated_pytorch_test_tpu.analysis.flow import (
+    ALL_RULES,
+    Program,
+    extract_module_summary,
+)
+from federated_pytorch_test_tpu.analysis.lint import main as lint_main
+from federated_pytorch_test_tpu.analysis.threads import (
+    MAIN_ROLE,
+    ThreadedJaxDispatch,
+    build_thread_model,
+)
+
+pytestmark = pytest.mark.lintthreads
+
+FIXTURES = Path(__file__).resolve().parent / "lint_fixtures"
+PKG = Path(__file__).resolve().parent.parent / "federated_pytorch_test_tpu"
+
+
+def _summary(src: str, path: str = "mod.py") -> dict:
+    return extract_module_summary(
+        ModuleContext(path=path, source=src, tree=ast.parse(src)))
+
+
+def _program(*named_sources) -> Program:
+    return Program([_summary(src, path) for path, src in named_sources])
+
+
+def _program_of_files(*paths) -> Program:
+    return Program([_summary(Path(p).read_text(), str(p)) for p in paths])
+
+
+def _lint_sources(*named_sources):
+    engine = LintEngine(ALL_RULES)
+    modules = []
+    for path, src in named_sources:
+        module, err = engine._parse(src, path)
+        assert err is None, err
+        modules.append(module)
+    return engine.lint_modules(modules)
+
+
+def _ids(result):
+    return {f.rule_id for f in result.findings}
+
+
+# ------------------------------------------------------------- fixtures
+
+class TestFixtures:
+    """One fixture per rule; each must fire its rule and ONLY its
+    rule (non-vacuous and non-overlapping)."""
+
+    @pytest.mark.parametrize("name,rule", [
+        ("jg112_shared_write.py", "JG112"),
+        ("jg113_blocking_under_lock.py", "JG113"),
+        ("jg114_check_then_act.py", "JG114"),
+        ("jg115_jit_from_thread.py", "JG115"),
+        ("jg116_lifecycle.py", "JG116"),
+    ])
+    def test_fixture_trips_exactly_its_rule(self, name, rule):
+        path = FIXTURES / name
+        result = LintEngine(ALL_RULES).lint_paths([str(path)])
+        assert _ids(result) == {rule}, (
+            f"{name}: expected only {rule}, got "
+            f"{[f'{f.rule_id}@{f.line}' for f in result.findings]}")
+
+    def test_jg116_reports_both_lifecycle_shapes(self):
+        result = LintEngine(ALL_RULES).lint_paths(
+            [str(FIXTURES / "jg116_lifecycle.py")])
+        msgs = " ".join(f.message for f in result.findings)
+        assert "no reachable join()" in msgs
+        assert "unbounded queue" in msgs
+
+
+# ------------------------------------------------------- role inference
+
+THREAD_SRC = (
+    "import threading\n"
+    "class P:\n"
+    "    def __init__(self):\n"
+    "        self._t = threading.Thread(target=self._work,\n"
+    "                                   name='prefetch')\n"
+    "        self._t.start()\n"
+    "    def _work(self):\n"
+    "        helper()\n"
+    "    def close(self):\n"
+    "        self._t.join()\n"
+    "def helper():\n"
+    "    return 1\n")
+
+POOL_SRC = (
+    "from concurrent.futures import ThreadPoolExecutor\n"
+    "def job(n):\n"
+    "    return stage(n)\n"
+    "def stage(n):\n"
+    "    return n + 1\n"
+    "class W:\n"
+    "    def __init__(self):\n"
+    "        self._pool = ThreadPoolExecutor(\n"
+    "            max_workers=1, thread_name_prefix='ckpt-writer')\n"
+    "    def submit(self, n):\n"
+    "        return self._pool.submit(job, n)\n"
+    "    def close(self):\n"
+    "        self._pool.shutdown(wait=True)\n")
+
+
+class TestRoleInference:
+    def test_thread_spawn_seeds_named_role_and_propagates(self):
+        prog = _program(("m.py", THREAD_SRC))
+        model = build_thread_model(prog)
+        work = prog.fns[("m.py", "P._work")]
+        helper = prog.fns[("m.py", "helper")]
+        assert "prefetch" in model.roles_of(work)
+        # propagated over the resolved call edge
+        assert "prefetch" in model.roles_of(helper)
+        # the public close() is a main root, not a worker
+        close = prog.fns[("m.py", "P.close")]
+        assert model.roles_of(close) == {MAIN_ROLE}
+
+    def test_pool_submit_role_is_the_thread_name_prefix(self):
+        prog = _program(("m.py", POOL_SRC))
+        model = build_thread_model(prog)
+        job = prog.fns[("m.py", "job")]
+        stage = prog.fns[("m.py", "stage")]
+        assert "ckpt-writer" in model.roles_of(job)
+        assert "ckpt-writer" in model.roles_of(stage)
+
+    def test_submit_on_unknown_object_is_not_a_spawn(self):
+        src = ("def job():\n    return 1\n"
+               "def go(d):\n    return d.submit(job)\n")
+        prog = _program(("m.py", src))
+        model = build_thread_model(prog)
+        job = prog.fns[("m.py", "job")]
+        assert model.worker_roles_of(job) == set()
+
+    def test_real_tree_ckpt_writer_role(self):
+        """utils/checkpoint.py: the AsyncCheckpointWriter pool submit
+        puts save_checkpoint_swapped on the ckpt-writer role — and on
+        the main role too (the engine also calls it synchronously)."""
+        prog = _program_of_files(PKG / "utils" / "checkpoint.py")
+        model = build_thread_model(prog)
+        path = str(PKG / "utils" / "checkpoint.py")
+        fn = prog.fns[(path, "save_checkpoint_swapped")]
+        assert "ckpt-writer" in model.roles_of(fn)
+
+    def test_real_tree_recorder_tap_is_main_role(self):
+        """obs/recorder.py round() -> health.observe() is a plain call
+        edge, NOT a spawn: the watchdog runs on the round loop."""
+        rec = str(PKG / "obs" / "recorder.py")
+        health = str(PKG / "obs" / "health.py")
+        prog = _program_of_files(rec, health)
+        model = build_thread_model(prog)
+        observe = prog.fns[(health, "HealthMonitor.observe")]
+        assert MAIN_ROLE in model.roles_of(observe)
+        assert model.worker_roles_of(observe) == set()
+
+    def test_real_tree_prefetch_role_reaches_round_batches(self):
+        lofar = str(PKG / "data" / "lofar.py")
+        prog = _program_of_files(lofar)
+        model = build_thread_model(prog)
+        rb = prog.fns[(lofar, "CPCDataSource.round_batches")]
+        assert "produce" in model.roles_of(rb)
+
+
+# ---------------------------------------------------------- non-vacuity
+
+GUARDED_WRITER = (
+    "import threading\n"
+    "class Worker:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self.status = 'idle'\n"
+    "        self._thread = threading.Thread(target=self._run)\n"
+    "        self._thread.start()\n"
+    "    def _run(self):\n"
+    "        with self._lock:\n"
+    "            self.status = 'running'\n"
+    "    def stop(self):\n"
+    "        with self._lock:\n"
+    "            self.status = 'stopped'\n"
+    "        self._thread.join()\n")
+
+UNGUARDED_WRITER = GUARDED_WRITER.replace(
+    "        with self._lock:\n            self.status",
+    "        self.status")
+
+
+class TestNonVacuity:
+    def test_common_lock_silences_jg112(self):
+        assert _ids(_lint_sources(("m.py", GUARDED_WRITER))) == set()
+
+    def test_unguarded_variant_fires_jg112(self):
+        assert _ids(_lint_sources(("m.py", UNGUARDED_WRITER))) == {"JG112"}
+
+    def test_locked_rmw_is_quiet_unlocked_fires(self):
+        base = (
+            "import threading\n"
+            "class Counter:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._n = 0\n"
+            "        self._thread = threading.Thread(target=self._tick)\n"
+            "        self._thread.start()\n"
+            "    def _tick(self):\n"
+            "        {guard}self._n += 1\n"
+            "    def bump(self):\n"
+            "        {guard}self._n += 1\n"
+            "    def stop(self):\n"
+            "        self._thread.join()\n")
+        locked = base.replace(
+            "{guard}self._n += 1",
+            "with self._lock:\n            self._n += 1")
+        unlocked = base.replace("{guard}", "")
+        assert _ids(_lint_sources(("m.py", locked))) == set()
+        got = _ids(_lint_sources(("m.py", unlocked)))
+        assert "JG114" in got and "JG112" in got
+
+    def test_main_thread_dispatch_is_not_jg115(self):
+        src = ("import jax.numpy as jnp\n"
+               "def norm(x):\n"
+               "    return jnp.sqrt(jnp.sum(x * x))\n")
+        assert _ids(_lint_sources(("m.py", src))) == set()
+
+    def test_bounded_queue_is_quiet(self):
+        src = Path(FIXTURES / "jg116_lifecycle.py").read_text()
+        bounded = src.replace("queue.Queue()", "queue.Queue(maxsize=2)")
+        joined = bounded.replace(
+            "    def push(self, item):",
+            "    def stop(self):\n"
+            "        self._thread.join()\n"
+            "    def push(self, item):")
+        assert _ids(_lint_sources(("m.py", joined))) == set()
+
+    def test_shipped_lofar_counter_is_locked_and_quiet(self):
+        """The PR-9 fix itself: the round counter bump holds the
+        source lock, so the shipped file carries no finding."""
+        lofar = PKG / "data" / "lofar.py"
+        result = LintEngine(ALL_RULES).lint_paths([str(lofar)])
+        assert _ids(result) == set()
+
+
+# ------------------------------------------------------ machine output
+
+class TestMachineOutput:
+    def test_sarif_carries_thread_rule_metadata(self, capsys):
+        rc = lint_main([str(FIXTURES / "jg115_jit_from_thread.py"),
+                        "--sarif"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        driver = doc["runs"][0]["tool"]["driver"]
+        levels = {r["id"]: r["defaultConfiguration"]["level"]
+                  for r in driver["rules"]}
+        for rid in ("JG112", "JG113", "JG114", "JG116"):
+            assert levels[rid] == "warning"
+        assert levels["JG115"] == "error"
+        results = doc["runs"][0]["results"]
+        assert {r["ruleId"] for r in results} == {"JG115"}
+        assert all(r["partialFingerprints"]["graftcheckFingerprint/v1"]
+                   for r in results)
+
+    def test_json_roundtrips_thread_findings(self, capsys):
+        rc = lint_main([str(FIXTURES / "jg116_lifecycle.py"), "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert doc["schema_version"] == 2
+        rules = {f["rule"] for f in doc["findings"]}
+        assert rules == {"JG116"}
+        assert all(f["fingerprint"] for f in doc["findings"])
+
+    def test_jg115_severity_is_error_for_fail_on(self, capsys):
+        assert ThreadedJaxDispatch.severity is Severity.ERROR
+        # --fail-on error: the JG115 fixture fails, a warning-only
+        # fixture passes
+        rc = lint_main([str(FIXTURES / "jg115_jit_from_thread.py"),
+                        "--fail-on", "error"])
+        capsys.readouterr()
+        assert rc == 1
+        rc = lint_main([str(FIXTURES / "jg112_shared_write.py"),
+                        "--fail-on", "error"])
+        capsys.readouterr()
+        assert rc == 0
+
+
+# --------------------------------------------------- extraction units
+
+class TestEffectExtraction:
+    def test_annassign_queue_make_records_boundedness(self):
+        s = _summary("import queue\n"
+                     "class C:\n"
+                     "    def __init__(self):\n"
+                     "        self._q: queue.Queue = queue.Queue(maxsize=1)\n"
+                     "        self._u = queue.Queue()\n")
+        makes = {m["token"]: m
+                 for m in s["functions"]["C.__init__"]["sync_makes"]}
+        assert makes["self._q"]["bounded"] is True
+        assert makes["self._u"]["bounded"] is False
+
+    def test_with_lock_marks_calls_and_stores_as_held(self):
+        s = _summary("import threading\n"
+                     "class C:\n"
+                     "    def __init__(self):\n"
+                     "        self._lock = threading.Lock()\n"
+                     "    def f(self, x):\n"
+                     "        with self._lock:\n"
+                     "            self.n = g(x)\n"
+                     "        self.m = g(x)\n")
+        fn = s["functions"]["C.f"]
+        held_calls = [c for c in fn["calls"] if c.get("held")]
+        assert len(held_calls) == 1
+        assert held_calls[0]["held"] == ["self._lock"]
+        stores = {e["n"]: e for e in fn["events"] if e["t"] == "astore"}
+        assert stores["n"]["h"] == ["self._lock"]
+        assert "h" not in stores["m"]
+
+    def test_acquire_release_bracket_held_spans(self):
+        s = _summary("class C:\n"
+                     "    def f(self):\n"
+                     "        self._lock.acquire()\n"
+                     "        g()\n"
+                     "        self._lock.release()\n"
+                     "        h()\n")
+        calls = s["functions"]["C.f"]["calls"]
+        by_line = {c["line"]: c.get("held") for c in calls}
+        assert by_line[4] == ["self._lock"]     # g() under the lock
+        assert by_line[6] is None               # h() after release
+
+    def test_augassign_on_attr_is_rmw(self):
+        s = _summary("class C:\n"
+                     "    def f(self):\n"
+                     "        self._n += 1\n")
+        evs = [e for e in s["functions"]["C.f"]["events"]
+               if e["t"] == "astore"]
+        assert evs and evs[0]["rmw"] is True
+
+    def test_check_then_act_brackets_body_not_orelse(self):
+        s = _summary("class C:\n"
+                     "    def f(self, k):\n"
+                     "        if k in self._d:\n"
+                     "            self._d[k] = 1\n"
+                     "        else:\n"
+                     "            self._other = 2\n")
+        evs = {e["n"]: e for e in s["functions"]["C.f"]["events"]
+               if e["t"] == "astore"}
+        assert evs["_d"]["chk"] == ["_d"]
+        assert "chk" not in evs["_other"]
+
+
+# --------------------------------------------- cache staleness (sat. 1)
+
+def _git(cwd, *args):
+    subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                    *args], cwd=cwd, check=True, capture_output=True)
+
+
+FACTORY_SRC = """\
+import jax
+from functools import partial
+
+
+class Trainer:
+    def _instrument_jit(self, fn, name, donate_argnums=()):
+        return jax.jit(fn, donate_argnums=donate_argnums)
+
+    def _build_fns(self, ci):
+        def body(state, z):
+            return state, z
+        comm_fns = {}
+        for mode in ("plain", "bb"):
+            comm_fns[mode] = self._instrument_jit(
+                partial(body), mode, donate_argnums=(0, 1))
+        return comm_fns
+"""
+
+CALLER_BAD_SRC = """\
+def drive(trainer, state, z):
+    comm_fns = trainer._build_fns(0)
+    for _ in range(3):
+        out = comm_fns["plain"](state, z)
+    return out
+"""
+
+
+class TestCacheAnalysisVersion:
+    """``--cache`` keys entries by sha1 AND the analysis-version token:
+    a token mismatch discards sha-matched entries, so editing rule /
+    extraction logic can never serve a stale summary (the PR-9 fix)."""
+
+    def _setup(self, tmp_path):
+        repo = tmp_path / "repo"
+        repo.mkdir()
+        _git(repo, "init", "-q")
+        (repo / "engine_c.py").write_text(FACTORY_SRC)
+        _git(repo, "add", "engine_c.py")
+        _git(repo, "commit", "-qm", "seed")
+        (repo / "bench_c.py").write_text(CALLER_BAD_SRC)
+        return repo, tmp_path / "cache.json"
+
+    def test_matching_token_uses_cached_summaries(self, tmp_path, capsys):
+        repo, cache = self._setup(tmp_path)
+        rc = lint_main([str(repo), "--changed", "HEAD",
+                        "--cache", str(cache)])
+        capsys.readouterr()
+        assert rc == 1                          # JG109 via the factory
+        # gut the cached factory summary; sha1 and token still match,
+        # so the (deliberately trusted) cache hides the finding
+        data = json.loads(cache.read_text())
+        key = next(k for k in data["summaries"] if "engine_c" in k)
+        entry = data["summaries"][key]
+        entry["summary"] = {
+            "version": entry["summary"]["version"],
+            "path": entry["summary"]["path"],
+            "module_name": entry["summary"]["module_name"],
+            "import_mods": {}, "import_syms": {}, "jnp_aliases": [],
+            "classes": {}, "functions": {}, "suppress": [],
+        }
+        cache.write_text(json.dumps(data))
+        rc = lint_main([str(repo), "--changed", "HEAD",
+                        "--cache", str(cache)])
+        capsys.readouterr()
+        assert rc == 0
+
+    def test_stale_token_forces_reextraction(self, tmp_path, capsys):
+        repo, cache = self._setup(tmp_path)
+        rc = lint_main([str(repo), "--changed", "HEAD",
+                        "--cache", str(cache)])
+        capsys.readouterr()
+        assert rc == 1
+        # same gutting, but now the file-level token is from an older
+        # analysis generation: the whole cache must be discarded and
+        # the finding must come back via fresh extraction
+        data = json.loads(cache.read_text())
+        key = next(k for k in data["summaries"] if "engine_c" in k)
+        data["summaries"][key]["summary"]["functions"] = {}
+        data["analysis_version"] = "older-generation"
+        cache.write_text(json.dumps(data))
+        rc = lint_main([str(repo), "--changed", "HEAD",
+                        "--cache", str(cache)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "JG109" in out
+        # and the rewritten cache carries the current token again
+        from federated_pytorch_test_tpu.analysis.flow import (
+            ANALYSIS_VERSION)
+        data = json.loads(cache.read_text())
+        assert data["analysis_version"] == ANALYSIS_VERSION
