@@ -511,6 +511,21 @@ def _measure(out: dict, progress=lambda: None) -> None:
     except Exception as e:
         print(f"bench_compression failed: {type(e).__name__}: {e}",
               file=sys.stderr)
+    try:                       # persistent-cache + cost-ledger attribution
+        from federated_pytorch_test_tpu.utils.compile_cache import cache_stats
+
+        out["compile_cache"] = cache_stats()
+        ledger = getattr(trainer, "_ledger", None)
+        if ledger is not None:
+            rate = ledger.cache_hit_rate()
+            if rate is not None:
+                out["cache_hit_rate"] = round(rate, 4)
+            totals = ledger.totals()
+            out["compile_events"] = totals["compile_events"]
+            out["compile_seconds"] = round(totals["compile_seconds"], 3)
+    except Exception as e:
+        print(f"bench compile-cache stats failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
     _close_bench_obs()
 
 

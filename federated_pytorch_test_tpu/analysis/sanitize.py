@@ -136,16 +136,25 @@ def throwing(jitted_fn: Callable) -> Callable:
 
 
 def instrument_jit(fn: Callable, name: str, *, sanitize: bool,
-                   sentinel: "TraceSentinel | None", **jit_kwargs) -> Callable:
+                   sentinel: "TraceSentinel | None",
+                   ledger=None, **jit_kwargs) -> Callable:
     """The one assembly point: conditionally checkify + count, then jit.
 
-    With both knobs off this is exactly ``jax.jit(fn, **jit_kwargs)``.
+    With all knobs off this is exactly ``jax.jit(fn, **jit_kwargs)``.
+    ``ledger`` is an ``obs.costs.CostLedger``: its trace counter wraps
+    the pre-jit callable (innermost, like the sentinel) and its dispatch
+    timer wraps the jitted fn directly — under ``throwing`` so the timed
+    window never includes the checkify host sync.
     """
     if sanitize:
         fn = checkify_callable(fn)
     if sentinel is not None:
         fn = sentinel.wrap(fn, name)
+    if ledger is not None:
+        fn = ledger.mark(fn, name)
     jfn = jax.jit(fn, **jit_kwargs)
+    if ledger is not None:
+        jfn = ledger.instrument(jfn, name)
     if sanitize:
         jfn = throwing(jfn)
     return jfn

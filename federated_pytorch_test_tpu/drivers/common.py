@@ -31,7 +31,8 @@ def build_parser(defaults: FederatedConfig, prog: str) -> argparse.ArgumentParse
                     "(reference parity: see module docstring)")
     # converters for Optional[...] fields (default None carries no type)
     _optional_types = {"data_dir": str, "num_devices": int,
-                       "profile_dir": str, "obs_dir": str}
+                       "profile_dir": str, "obs_dir": str,
+                       "compile_cache_dir": str}
     # tri-state booleans: absent -> None (auto), --flag/--no-flag override
     _optional_bools = {"device_data", "donate"}
     for f in dataclasses.fields(FederatedConfig):
@@ -66,6 +67,13 @@ def build_parser(defaults: FederatedConfig, prog: str) -> argparse.ArgumentParse
                      "emits alert records, abort raises RunHealthAbort, "
                      "checkpoint-abort saves+verifies a final checkpoint "
                      "first (default: warn)")
+        elif f.name == "compile_cache_dir":
+            p.add_argument(
+                arg, type=str, default=default, metavar="DIR",
+                help="persistent XLA compile-cache dir "
+                     "(utils/compile_cache.py); default: auto "
+                     "(FEDTPU_COMPILE_CACHE_DIR env, else tests/.jax_cache)"
+                     "; the literal 'none' disables the cache")
         elif default is None:
             conv = _optional_types.get(f.name)
             if conv is None:
@@ -114,7 +122,7 @@ def setup_runtime(cfg: FederatedConfig) -> None:
         enable_persistent_compile_cache,
     )
 
-    enable_persistent_compile_cache()
+    enable_persistent_compile_cache(getattr(cfg, "compile_cache_dir", None))
     apply_platform(cfg)
 
 

@@ -8,12 +8,18 @@
 - :mod:`.trace`    — span timeline → Chrome trace-event JSON exporter.
 - :mod:`.health`   — streaming anomaly watchdog (``--health-action``).
 - :mod:`.compare`  — cross-run regression CLI (CI gate).
+- :mod:`.costs`    — per-jit-site compile/HLO device-cost ledger.
+- :mod:`.profile`  — ``python -m federated_pytorch_test_tpu.obs.profile``.
 
 See README "Observability" for the artifact format and how XProf traces
 (``--profile-dir`` + per-round ``StepTraceAnnotation``) correlate with
 the JSONL timeline.
 """
 
+from federated_pytorch_test_tpu.obs.costs import (  # noqa: F401
+    CostLedger,
+    round_cost_fields,
+)
 from federated_pytorch_test_tpu.obs.health import (  # noqa: F401
     HEALTH_ACTIONS,
     HealthMonitor,

@@ -49,6 +49,14 @@ _DIRECTION = {
     "comm_overhead_frac": -1,
     "mfu": +1,
     "value": +1,
+    # device-cost ledger metrics (schema v6; obs/profile.py): a compile-
+    # time or device-memory regression fails the gate like a throughput
+    # regression does
+    "compile_seconds": -1,
+    "compile_seconds_cold": -1,
+    "peak_device_bytes": -1,
+    "utilization": +1,
+    "cache_hit_rate": +1,
 }
 
 
@@ -75,16 +83,24 @@ def load_source(path: str) -> Dict[str, Any]:
     src: Dict[str, Any] = {"path": path, "kind": "?", "metrics": {},
                            "notes": [], "baseline_ref": None}
     if path.endswith(".jsonl"):
+        from federated_pytorch_test_tpu.obs.profile import profile_metrics
         from federated_pytorch_test_tpu.obs.report import (
             read_records,
             summarize,
         )
 
-        s = summarize(read_records(path))
+        records = read_records(path)
+        s = summarize(records)
         src["kind"] = f"run ({s.get('engine') or '?'}, {s.get('status')})"
         for k in ("images_per_sec", "rounds_per_sec", "loss_final",
                   "comm_overhead_frac", "compression_savings_frac"):
             v = _num(s.get(k))
+            if v is not None:
+                src["metrics"][k] = v
+        # device-cost metrics (schema v6): present only when the run's
+        # ledger emitted them, so pre-v6 streams compare unchanged
+        for k, val in profile_metrics(records).items():
+            v = _num(val)
             if v is not None:
                 src["metrics"][k] = v
         if s.get("status") != "completed":

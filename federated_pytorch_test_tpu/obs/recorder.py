@@ -116,6 +116,16 @@ class RunRecorder:
         self.health = None
         self._span_extent: Optional[List[float]] = None
         self._alerts = 0
+        # device-cost ledger totals (schema v6): compile events emitted
+        # through compile_event(), and the device-memory high-watermark
+        # tracked across round records (device_memory_stats is
+        # instantaneous; the run-level peak belongs on the summary)
+        self._compile_events = 0
+        self._compile_seconds = 0.0
+        self._cache_hits = 0
+        self._cache_misses = 0
+        self._mem_watermark: Optional[int] = None
+        self._mem_final: Optional[int] = None
 
     @property
     def memory(self) -> Optional[List[dict]]:
@@ -240,6 +250,15 @@ class RunRecorder:
                     self.totals.timer(k[: -len("_seconds")]).observe(v)
             if isinstance(rec.get("quarantined"), int):
                 self.totals.gauge("quarantined_last").set(rec["quarantined"])
+            for k in ("mem_peak_bytes_in_use", "mem_bytes_in_use"):
+                v = rec.get(k)
+                if isinstance(v, int) and not isinstance(v, bool):
+                    if self._mem_watermark is None or v > self._mem_watermark:
+                        self._mem_watermark = v
+                    break  # prefer the backend's peak over instantaneous
+            v = rec.get("mem_bytes_in_use")
+            if isinstance(v, int) and not isinstance(v, bool):
+                self._mem_final = v
             loss = rec.get("loss")
             if isinstance(loss, (int, float)):
                 if self._loss_first is None:
@@ -296,6 +315,44 @@ class RunRecorder:
         rec.update(json_safe(fields))
         return self._emit(rec)
 
+    def compile_event(self, fields: Dict[str, Any], *,
+                      parent_span: Optional[str] = None) -> Optional[dict]:
+        """Emit one ``compile`` record (schema v6; obs/costs.py).
+
+        ``fields`` is a :meth:`~..obs.costs.CompileEvent.record` body:
+        ``site`` + ``compile_seconds`` required, AOT cost fields
+        optional.  When it carries ``t_start``/``t_end`` the record
+        doubles as a span — parented to ``parent_span`` (the enclosing
+        round) or, for events drained outside any round window, to the
+        run span, keeping the Chrome-trace nesting laminar.
+        """
+        if not self.enabled:
+            return None
+        rec: Dict[str, Any] = {"event": "compile", "schema": SCHEMA_VERSION,
+                               "run_id": self.run_id, "engine": self.engine}
+        if self.algorithm is not None:
+            rec["algorithm"] = self.algorithm
+        rec.update(json_safe(fields))
+        t0, t1 = rec.get("t_start"), rec.get("t_end")
+        if (isinstance(t0, (int, float)) and not isinstance(t0, bool)
+                and isinstance(t1, (int, float))
+                and not isinstance(t1, bool)):
+            rec.setdefault("span_id", uuid.uuid4().hex[:12])
+            parent = parent_span or self.run_span_id
+            if parent is not None:
+                rec.setdefault("parent_span", parent)
+            self._grow_extent(t0, t1)
+        self._compile_events += 1
+        secs = rec.get("compile_seconds")
+        if isinstance(secs, (int, float)) and not isinstance(secs, bool):
+            self._compile_seconds += float(secs)
+        hit = rec.get("cache_hit")
+        if hit is True:
+            self._cache_hits += 1
+        elif hit is False:
+            self._cache_misses += 1
+        return self._emit(rec)
+
     def close(self, status: str = "completed",
               extra: Optional[dict] = None) -> Optional[dict]:
         """Emit the summary event and close every sink. Idempotent."""
@@ -340,6 +397,17 @@ class RunRecorder:
             rec["loss_final"] = self._loss_final
         if self._alerts or self.health is not None:
             rec["alerts_total"] = self._alerts
+        if self._compile_events:
+            rec["compile_events_total"] = self._compile_events
+            rec["compile_seconds_total"] = self._compile_seconds
+            if self._cache_hits or self._cache_misses:
+                rec["cache_hits_total"] = self._cache_hits
+                rec["cache_misses_total"] = self._cache_misses
+        if self._mem_watermark is not None:
+            rec["mem_peak_bytes_watermark"] = int(self._mem_watermark)
+            if self._mem_final is not None:
+                rec["mem_final_vs_peak_bytes"] = int(
+                    self._mem_watermark - self._mem_final)
         rs = rec.get("round_seconds_total", 0.0)
         if rounds and rs:
             rec["rounds_per_sec"] = rounds / rs

@@ -136,6 +136,27 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     alerts = [r for r in records if r.get("event") == "alert"]
     out["alerts"] = len(alerts)
     out["alert_rules"] = sorted({a.get("rule", "?") for a in alerts})
+    # device-cost ledger (schema v6): compile totals recomputed from the
+    # round records; the memory watermark is the max across the rounds'
+    # instantaneous stats (matches the recorder's summary field)
+    compiles = [r for r in records if r.get("event") == "compile"]
+    out["compile_events"] = len(compiles)
+    out["compile_seconds_total"] = tot("compile_seconds")
+    mem_peaks = []
+    mem_in_use = []
+    for r in rounds:
+        for key, dst in (("mem_peak_bytes_in_use", mem_peaks),
+                         ("mem_bytes_in_use", mem_in_use)):
+            v = r.get(key)
+            if isinstance(v, int) and not isinstance(v, bool):
+                dst.append(v)
+    out["mem_peak_bytes_watermark"] = (
+        max(mem_peaks) if mem_peaks
+        else (max(mem_in_use) if mem_in_use else None))
+    out["mem_final_vs_peak_bytes"] = (
+        out["mem_peak_bytes_watermark"] - mem_in_use[-1]
+        if out["mem_peak_bytes_watermark"] is not None and mem_in_use
+        else None)
     return out
 
 
@@ -201,6 +222,18 @@ def format_report(s: Dict[str, Any]) -> str:
     if s.get("alerts"):
         row("health alerts",
             f"{s['alerts']} alert(s): {', '.join(s.get('alert_rules') or [])}")
+    if s.get("compile_events") or s.get("compile_seconds_total"):
+        msg = f"{s.get('compile_events', 0)} event(s)"
+        if s.get("compile_seconds_total") is not None:
+            msg += f", {s['compile_seconds_total']:.2f} s"
+        msg += "  (details: python -m federated_pytorch_test_tpu.obs.profile)"
+        row("compile", msg)
+    if s.get("mem_peak_bytes_watermark") is not None:
+        msg = "watermark " + _fmt_bytes(s["mem_peak_bytes_watermark"])
+        if s.get("mem_final_vs_peak_bytes") is not None:
+            msg += (", final vs peak "
+                    + _fmt_bytes(s["mem_final_vs_peak_bytes"]))
+        row("device memory", msg)
     if s.get("loss_first") is not None:
         row("loss", f"first={s['loss_first']:.6g} "
             f"final={s['loss_final']:.6g}")
@@ -209,9 +242,9 @@ def format_report(s: Dict[str, Any]) -> str:
 
 def selftest() -> str:
     """Recorder → JSONL → parse → validate → summarise round-trip, plus
-    the trace-exporter, watchdog, and compare selftests (tier-1 runs
-    this, so the whole live-health layer is exercised without a prior
-    training run)."""
+    the trace-exporter, watchdog, compare, and cost-profile selftests
+    (tier-1 runs this, so the whole live-health + device-cost layer is
+    exercised without a prior training run)."""
     import os
     import tempfile
 
@@ -253,15 +286,17 @@ def selftest() -> str:
     assert record_ips({"images": 256, "round_seconds": 0}) == float("inf")
     assert record_ips({"images": 0, "round_seconds": 0}) == 0.0
 
-    from federated_pytorch_test_tpu.obs import compare, health, trace
+    from federated_pytorch_test_tpu.obs import compare, health, profile, trace
 
     trace.selftest()
     health.selftest()
     compare.selftest()
+    profile.selftest()
     return (table
             + "\nobs trace selftest: OK (Chrome trace valid)"
             + "\nobs health selftest: OK (NaN streak alerted)"
             + "\nobs compare selftest: OK (regression gate works)"
+            + "\nobs profile selftest: OK (cost attribution reconstructs)"
             + "\nobs report selftest: OK")
 
 

@@ -1,6 +1,6 @@
 """Versioned record schema for run telemetry.
 
-One run = one JSONL stream of five event kinds:
+One run = one JSONL stream of six event kinds:
 
 - ``run_header``  — emitted once when a run (or resumed segment) opens:
   config snapshot, mesh shape, jax/backend versions, git rev.
@@ -16,6 +16,10 @@ One run = one JSONL stream of five event kinds:
 - ``alert``       — a streaming-watchdog verdict (schema v5;
   ``obs/health.py``): which rule tripped, on which round, and what the
   configured ``--health-action`` did about it.
+- ``compile``     — one per observed jit compile event (schema v6;
+  ``obs/costs.py``): site label, compile wall-seconds, trace count,
+  AOT cost-model / memory-analysis numbers where available, and
+  persistent-compile-cache hit/miss attribution.
 
 The schema unifies what ``engine.py``, ``cpc_engine.py`` and
 ``vae_engine.py`` used to build as ad-hoc dicts; every record carries
@@ -51,10 +55,21 @@ from typing import Any, Dict
 # obs/trace.py and keyed to the same `round_index` the XProf round_trace
 # annotations use), a new `alert` record kind (obs/health.py streaming
 # watchdog verdicts), and `alerts_total` on the summary.
-# v1..v4 records remain valid: validate_record accepts ver <= SCHEMA_VERSION.
-SCHEMA_VERSION = 5
+# v6 (additive): the device-cost ledger (obs/costs.py) — a new `compile`
+# record kind (one per observed jit compile: `site`, `compile_seconds`,
+# `trace_count`, AOT cost-model `flops` / `hlo_bytes_accessed` /
+# `transcendentals` and memory_analysis byte fields where the backend
+# supports them, `cache_hit` persistent-cache attribution; carries
+# span_id/parent_span/t_start/t_end so compile events render as bubbles
+# inside rounds in the Chrome-trace export), per-round `compile_seconds`
+# / `flops_round` / `hlo_bytes_accessed` / `peak_device_bytes` /
+# `cache_hit`, and summary compile/cache totals plus the device-memory
+# high-watermark pair.  ALL cost fields are advisory: absent means "the
+# backend/mode did not produce it", never zero (PARITY.md).
+# v1..v5 records remain valid: validate_record accepts ver <= SCHEMA_VERSION.
+SCHEMA_VERSION = 6
 
-EVENTS = ("run_header", "round", "summary", "span", "alert")
+EVENTS = ("run_header", "round", "summary", "span", "alert", "compile")
 
 
 class SchemaError(ValueError):
@@ -77,8 +92,8 @@ FIELDS: Dict[str, Any] = {
     "schema":       (EVENTS, _INT),
     "run_id":       (EVENTS, _STR),
     "run_name":     (("run_header",), _STR),
-    "engine":       (("run_header", "round"), _STR),
-    "algorithm":    (("run_header", "round"), _STR),
+    "engine":       (("run_header", "round", "compile"), _STR),
+    "algorithm":    (("run_header", "round", "compile"), _STR),
     # header
     "time_unix":    (("run_header", "summary", "alert"), _NUM),
     "config":       (("run_header",), _DICT),
@@ -95,7 +110,7 @@ FIELDS: Dict[str, Any] = {
     "pid":          (("run_header",), _INT),
     # round coordinates (spans and alerts are keyed to the same index the
     # XProf round_trace annotations use, so all three timelines correlate)
-    "round_index":  (("round", "span", "alert"), _INT),
+    "round_index":  (("round", "span", "alert", "compile"), _INT),
     "nloop":        (("round",), _INT),
     "block":        (("round",), _INT),
     "nadmm":        (("round",), _INT),
@@ -147,15 +162,33 @@ FIELDS: Dict[str, Any] = {
     # device memory (absent when the backend reports none, e.g. CPU)
     "mem_bytes_in_use": (("round",), _INT),
     "mem_peak_bytes_in_use": (("round",), _INT),
+    # device-cost ledger (schema v6; obs/costs.py).  Round-level fields
+    # aggregate the compile events and executed cost-model numbers of
+    # that round's dispatch window; `compile` records carry the per-event
+    # detail.  Every one of these is optional — omitted, never zeroed,
+    # when the backend/AOT mode does not produce it.
+    "site":         (("compile",), _STR),     # jit site label
+    "compile_seconds": (("round", "compile"), _NUM),
+    "trace_count":  (("compile",), _INT),     # cumulative; 1 == cold
+    "flops":        (("compile",), _NUM),     # per-dispatch cost model
+    "flops_round":  (("round",), _NUM),       # executed (sum over window)
+    "hlo_bytes_accessed": (("round", "compile"), _NUM),
+    "transcendentals": (("compile",), _NUM),
+    "argument_bytes": (("compile",), _INT),   # memory_analysis (full AOT)
+    "output_bytes": (("compile",), _INT),
+    "temp_bytes":   (("compile",), _INT),
+    "generated_code_bytes": (("compile",), _INT),
+    "peak_device_bytes": (("round", "compile"), _INT),
+    "cache_hit":    (("round", "compile"), _BOOL),
     # span tracing (schema v5; obs/trace.py).  `span_id`/`parent_span`
     # ride additively on existing records; `t_start`/`t_end` are HOST
     # MONOTONIC (time.perf_counter) stamps taken at the phase boundaries
     # the engines already time — device-phase durations come from the
     # existing `_obs_sync` sync points, no new syncs are introduced.
-    "span_id":      (("run_header", "round", "span"), _STR),
-    "parent_span":  (("round", "span"), _STR),
-    "t_start":      (("round", "span"), _NUM),
-    "t_end":        (("round", "span"), _NUM),
+    "span_id":      (("run_header", "round", "span", "compile"), _STR),
+    "parent_span":  (("round", "span", "compile"), _STR),
+    "t_start":      (("round", "span", "compile"), _NUM),
+    "t_end":        (("round", "span", "compile"), _NUM),
     "name":         (("span",), _STR),        # phase/sub-span label
     "cat":          (("span",), _STR),        # run|round|phase|comm|ckpt|...
     # streaming watchdog verdicts (schema v5; obs/health.py)
@@ -188,6 +221,13 @@ FIELDS: Dict[str, Any] = {
     "comm_overhead_frac": (("summary",), _NUM),
     "compression_savings_frac": (("summary",), _NUM),
     "alerts_total": (("summary",), _INT),
+    # device-cost + memory-watermark summary (schema v6)
+    "compile_events_total": (("summary",), _INT),
+    "compile_seconds_total": (("summary",), _NUM),
+    "cache_hits_total": (("summary",), _INT),
+    "cache_misses_total": (("summary",), _INT),
+    "mem_peak_bytes_watermark": (("summary",), _INT),
+    "mem_final_vs_peak_bytes": (("summary",), _INT),
 }
 
 REQUIRED = {
@@ -198,6 +238,7 @@ REQUIRED = {
     "span": ("event", "schema", "run_id", "span_id", "name", "t_start",
              "t_end"),
     "alert": ("event", "schema", "run_id", "rule", "round_index"),
+    "compile": ("event", "schema", "run_id", "site", "compile_seconds"),
 }
 
 

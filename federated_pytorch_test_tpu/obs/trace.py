@@ -5,6 +5,9 @@ Schema v5 gives the run stream a span hierarchy::
     run (span record at close, id stamped on the run_header)
     └── round N        (the round record itself, when it carries t_start)
         ├── train / stage / comm / sync ...   (span records, cat="phase")
+        ├── compile <site>   (schema v6 compile records: bubbles showing
+        │                     where jit compiles landed inside the round;
+        │                     out-of-window events parent to the RUN span)
         └── ...
     └── ckpt           (parented to the RUN span: the mid-run save runs
                         after round_seconds is measured, so hanging it
@@ -71,6 +74,16 @@ def _spans_in(seg: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
                         "parent_span": r.get("parent_span"),
                         "name": r.get("name", "span"),
                         "cat": r.get("cat", "phase"),
+                        "t_start": float(t0), "t_end": float(t1),
+                        "round_index": r.get("round_index")})
+        elif ev == "compile":
+            # schema v6: compile events render as bubbles inside their
+            # round (in-window) or directly under the run span (events
+            # drained outside any round window, e.g. eval compiles)
+            out.append({"span_id": r.get("span_id"),
+                        "parent_span": r.get("parent_span"),
+                        "name": f"compile {r.get('site', '?')}",
+                        "cat": "compile",
                         "t_start": float(t0), "t_end": float(t1),
                         "round_index": r.get("round_index")})
     return out

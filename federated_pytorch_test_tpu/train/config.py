@@ -251,3 +251,21 @@ class FederatedConfig:
     # recompilation regressions show up in the perf trajectory.
     sanitize: bool = False
     retrace_sentinel: bool = False
+
+    # device-cost ledger (obs/costs.py) — default ON: per-jit-site
+    # compile wall-seconds, AOT cost-model FLOPs/bytes, and persistent-
+    # compile-cache hit/miss attribution, drained into the obs round
+    # records (schema v6) and `compile` events.  The wrappers only time
+    # dispatch and read cached AOT analyses — training math is
+    # bit-identical on/off (tested); --no-cost-ledger rebuilds the
+    # literal uninstrumented chain.  AOT depth: FEDTPU_COST_AOT
+    # (off|lowered|full, default lowered; "full" adds memory_analysis at
+    # the price of a second compile per program).
+    cost_ledger: bool = True
+
+    # persistent XLA compile-cache directory (utils/compile_cache.py):
+    # None -> auto (FEDTPU_COMPILE_CACHE_DIR env, else tests/.jax_cache
+    # with an XDG fallback); the literal string "none" disables the
+    # persistent cache for this run (cost-ledger cache_hit attribution
+    # is then omitted).
+    compile_cache_dir: Optional[str] = None

@@ -34,6 +34,7 @@ from federated_pytorch_test_tpu.models.cpc import (
     EncoderCNN,
     PredictorCNN,
 )
+from federated_pytorch_test_tpu.obs.costs import CostLedger, round_cost_fields
 from federated_pytorch_test_tpu.optim.lbfgs import LBFGSNew
 from federated_pytorch_test_tpu.parallel.comm import federated_mean
 from federated_pytorch_test_tpu.parallel.mesh import (
@@ -75,7 +76,7 @@ class CPCTrainer:
                  lbfgs_max_iter: int = 2, Niter: int = 10,
                  init_seed: int = 0, num_devices: Optional[int] = None,
                  sanitize: bool = False, retrace_sentinel: bool = False,
-                 donate: Optional[bool] = None):
+                 donate: Optional[bool] = None, cost_ledger: bool = True):
         self.data = data
         self.K = data.K
         self.Niter = Niter
@@ -96,6 +97,9 @@ class CPCTrainer:
         # the literal uninstrumented jax.jit(shard_map(...)) chain
         self.sanitize = bool(sanitize)
         self._sentinel = TraceSentinel() if retrace_sentinel else None
+        # device-cost ledger (obs/costs.py, classifier-engine parity):
+        # default ON; None rebuilds the uninstrumented chain
+        self._ledger = CostLedger() if cost_ledger else None
         self.models = {
             "encoder": EncoderCNN(latent_dim=latent_dim),
             "contextgen": ContextgenCNN(latent_dim=latent_dim),
@@ -301,6 +305,7 @@ class CPCTrainer:
         # every round and left alone
         fn = instrument_jit(inner, f"round[{mdl},blk={ci},{px}x{py}]",
                             sanitize=False, sentinel=self._sentinel,
+                            ledger=self._ledger,
                             donate_argnums=((0, 1, 2) if self._donate
                                             else ()))
         if self.sanitize:
@@ -613,6 +618,13 @@ class CPCTrainer:
                                 if self._sentinel is not None:
                                     rec["jit_retraces"] = \
                                         self._sentinel.retraces
+                                ledger_events = ()
+                                if self._ledger is not None:
+                                    rcosts = self._ledger.drain()
+                                    ledger_events = rcosts.events
+                                    rec.update(round_cost_fields(
+                                        rcosts, t_round,
+                                        rec["round_seconds"]))
                                 history.append(rec)
                                 if checkpoint_path is not None:
                                     if nadmm + 1 < Nadmm:
@@ -656,6 +668,18 @@ class CPCTrainer:
                                                 "ckpt", t_ckpt, t_ckpt
                                                 + rec["ckpt_write_seconds"],
                                                 cat="ckpt", round_index=ridx)
+                                        t_hi = (t_round
+                                                + rec["round_seconds"] + 1e-9)
+                                        for cev in ledger_events:
+                                            in_rnd = (
+                                                rspan is not None
+                                                and cev.t_start
+                                                >= t_round - 1e-9
+                                                and cev.t_end <= t_hi)
+                                            obs.compile_event(
+                                                cev.record(round_index=ridx),
+                                                parent_span=(rspan if in_rnd
+                                                             else None))
                                     if (obs.health is not None
                                             and obs.health.tripped
                                             is not None):

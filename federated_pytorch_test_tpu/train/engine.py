@@ -41,6 +41,7 @@ from federated_pytorch_test_tpu.compress import make_compressor, stacked_init
 from federated_pytorch_test_tpu.data.cifar10 import FederatedCifar10
 from federated_pytorch_test_tpu.models.base import BlockModule
 from federated_pytorch_test_tpu.obs import device_memory_stats
+from federated_pytorch_test_tpu.obs.costs import CostLedger, round_cost_fields
 from federated_pytorch_test_tpu.optim.lbfgs import LBFGSNew
 from federated_pytorch_test_tpu.parallel.mesh import (
     CLIENT_AXIS,
@@ -264,6 +265,12 @@ class BlockwiseFederatedTrainer:
         # builders wrap nothing and the jitted chain is literally the
         # uninstrumented one
         self._sentinel = TraceSentinel() if cfg.retrace_sentinel else None
+        # device-cost ledger (obs/costs.py): per-jit-site compile
+        # wall-seconds + AOT cost-model numbers + compile-cache
+        # attribution, drained into the obs round records each round.
+        # None when off so the jitted chain is literally the
+        # uninstrumented one (same contract as the sentinel)
+        self._ledger = CostLedger() if cfg.cost_ledger else None
         # stateless per-epoch randomness: epochs are keyed on a counter
         # (see _epoch_seed), so the NEXT epoch's host-side shuffle/gather
         # can be built on a worker thread while the devices compute this
@@ -411,12 +418,13 @@ class BlockwiseFederatedTrainer:
     # compiled steps (built per block; cached)
     # ------------------------------------------------------------------
     def _instrument_jit(self, fn, name: str, **jit_kwargs):
-        """jit ``fn`` with the config's sanitize/retrace instrumentation
-        (analysis/sanitize.py).  With both knobs off — the default —
+        """jit ``fn`` with the config's sanitize/retrace/cost-ledger
+        instrumentation (analysis/sanitize.py).  With all knobs off
         this is exactly ``jax.jit(fn, **jit_kwargs)``: the dense path
         stays bit-identical by construction."""
         return instrument_jit(fn, name, sanitize=self.cfg.sanitize,
-                              sentinel=self._sentinel, **jit_kwargs)
+                              sentinel=self._sentinel,
+                              ledger=self._ledger, **jit_kwargs)
 
     def _donate_argnums(self, argnums) -> tuple:
         """donate_argnums for a step jit: the real tuple when donation is
@@ -1801,6 +1809,15 @@ class BlockwiseFederatedTrainer:
                             # cumulative traces-beyond-first: flat in steady
                             # state, growing when something retraces
                             rec["jit_retraces"] = self._sentinel.retraces
+                        # drain the cost ledger BEFORE the eval below: an
+                        # eval compile lands in the next round's drain and
+                        # is attributed to the run, not this round
+                        ledger_events = ()
+                        if self._ledger is not None:
+                            rcosts = self._ledger.drain()
+                            ledger_events = rcosts.events
+                            rec.update(round_cost_fields(
+                                rcosts, t_round, rec["round_seconds"]))
                         if cfg.update_guard and algo.communicates:
                             # quarantine census at round START (who sat this
                             # round out), next to the guard_trips the round
@@ -1866,6 +1883,18 @@ class BlockwiseFederatedTrainer:
                                     obs.span("ckpt", t_ckpt, t_ckpt
                                              + rec["ckpt_write_seconds"],
                                              cat="ckpt", round_index=ridx)
+                                t_hi = t_round + rec["round_seconds"] + 1e-9
+                                for cev in ledger_events:
+                                    # in-window compiles nest inside the
+                                    # round span; late-drained ones (eval
+                                    # compiles from a prior round) hang off
+                                    # the RUN span to keep nesting laminar
+                                    in_rnd = (rspan is not None
+                                              and cev.t_start >= t_round - 1e-9
+                                              and cev.t_end <= t_hi)
+                                    obs.compile_event(
+                                        cev.record(round_index=ridx),
+                                        parent_span=rspan if in_rnd else None)
                             if (obs.health is not None
                                     and obs.health.tripped is not None):
                                 self._health_abort(
@@ -1929,6 +1958,14 @@ class BlockwiseFederatedTrainer:
                        host_dispatches=1)
             if self._sentinel is not None:
                 rec["jit_retraces"] = self._sentinel.retraces
+            # drain before the eval: eval compiles attribute to the run
+            # via the next epoch's drain, not this epoch's window
+            ledger_events = ()
+            if self._ledger is not None:
+                rcosts = self._ledger.drain()
+                ledger_events = rcosts.events
+                rec.update(round_cost_fields(
+                    rcosts, t_epoch, rec["epoch_seconds"]))
             if cfg.check_results:
                 rec["accuracy"] = self.evaluate(state)
                 log(f"Epoch {epoch} acc="
@@ -1937,10 +1974,20 @@ class BlockwiseFederatedTrainer:
                 log(f"Epoch {epoch} loss={rec['loss']:e}")
             history.append(rec)
             if obs.enabled or obs.health is not None:
-                obs.round(dict(rec, round_index=epoch,
-                               round_seconds=rec["epoch_seconds"],
-                               images=obs_images, t_start=t_epoch,
-                               **device_memory_stats()))
+                rrec = obs.round(dict(rec, round_index=epoch,
+                                      round_seconds=rec["epoch_seconds"],
+                                      images=obs_images, t_start=t_epoch,
+                                      **device_memory_stats()))
+                if obs.enabled:
+                    rspan = (rrec or {}).get("span_id")
+                    t_hi = t_epoch + rec["epoch_seconds"] + 1e-9
+                    for cev in ledger_events:
+                        in_rnd = (rspan is not None
+                                  and cev.t_start >= t_epoch - 1e-9
+                                  and cev.t_end <= t_hi)
+                        obs.compile_event(
+                            cev.record(round_index=epoch),
+                            parent_span=rspan if in_rnd else None)
                 if (obs.health is not None
                         and obs.health.tripped is not None):
                     # no mid-run checkpointing on this path:

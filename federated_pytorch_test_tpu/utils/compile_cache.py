@@ -1,43 +1,63 @@
 """Shared persistent XLA compile-cache setup.
 
-One helper for the three compile-heavy entry surfaces (tests/conftest.py,
-__graft_entry__.py, bench.py): first compiles dominate their wall-clock, so
-they share one on-disk cache that survives across processes and rounds.
-The default location is the historical ``tests/.jax_cache`` (kept so
-existing warm entries stay valid).
+One helper for the compile-heavy entry surfaces (tests/conftest.py,
+__graft_entry__.py, bench.py, drivers/common.py): first compiles dominate
+their wall-clock, so they share one on-disk cache that survives across
+processes and rounds.  The default location is the historical
+``tests/.jax_cache`` (kept so existing warm entries stay valid).
+
+Overrides, highest precedence first:
+
+- explicit ``cache_dir`` argument (drivers: ``--compile-cache-dir``)
+- ``FEDTPU_COMPILE_CACHE_DIR`` environment variable
+- the tests/.jax_cache default (XDG fallback when unwritable)
+
+The literal value ``none`` (case-insensitive, argument or env) disables
+the persistent cache entirely: jax config is left untouched and ``""``
+is returned.  ``cache_stats()`` reports entry count / total bytes for
+the bench artifact and the cost ledger's hit/miss attribution
+(obs/costs.py watches the entry count across compile events).
 """
 
 from __future__ import annotations
 
 import os
-from typing import Optional
+import stat
+from typing import Any, Dict, Optional
 
 import jax
+
+DISABLE = "none"
+
+
+def _default_cache_dir() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    cache_dir = os.path.join(root, "tests", ".jax_cache")
+    if not os.access(os.path.join(root, "tests")
+                     if os.path.isdir(os.path.join(root, "tests"))
+                     else root, os.W_OK):
+        cache_dir = os.path.join(
+            os.environ.get("XDG_CACHE_HOME",
+                           os.path.expanduser("~/.cache")),
+            "federated-pytorch-test-tpu", "jax_cache")
+    return cache_dir
 
 
 def enable_persistent_compile_cache(cache_dir: Optional[str] = None) -> str:
     """Point JAX's persistent compilation cache at ``cache_dir``.
 
     Safe to call at any time (before or after backend init); failures are
-    swallowed because a missing cache only costs compile time.
-
-    Default location: the repo-checkout ``tests/.jax_cache`` (shared with
-    the test suite / graft entry / bench so warm entries carry across) —
-    but only when that tree is writable; an installed (site-packages,
-    possibly read-only) copy of the package falls back to a per-user
-    cache dir instead of writing inside the installation.
+    swallowed because a missing cache only costs compile time.  Returns
+    the directory in effect, or ``""`` when disabled via the ``none``
+    switch (see module docstring for the override precedence).
     """
     if cache_dir is None:
-        root = os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
-        cache_dir = os.path.join(root, "tests", ".jax_cache")
-        if not os.access(os.path.join(root, "tests")
-                         if os.path.isdir(os.path.join(root, "tests"))
-                         else root, os.W_OK):
-            cache_dir = os.path.join(
-                os.environ.get("XDG_CACHE_HOME",
-                               os.path.expanduser("~/.cache")),
-                "federated-pytorch-test-tpu", "jax_cache")
+        cache_dir = os.environ.get("FEDTPU_COMPILE_CACHE_DIR") or None
+    if cache_dir is not None and str(cache_dir).strip().lower() == DISABLE:
+        return ""
+    if cache_dir is None:
+        cache_dir = _default_cache_dir()
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
@@ -45,3 +65,34 @@ def enable_persistent_compile_cache(cache_dir: Optional[str] = None) -> str:
     except Exception:
         pass
     return cache_dir
+
+
+def cache_stats(cache_dir: Optional[str] = None) -> Dict[str, Any]:
+    """Entry count / total bytes / location of the persistent cache.
+
+    With no argument, reads the directory jax is currently configured
+    with (empty stats when the cache is disabled or the dir is missing —
+    never raises; this feeds the bench artifact).
+    """
+    if cache_dir is None:
+        try:
+            cache_dir = jax.config.jax_compilation_cache_dir
+        except Exception:
+            cache_dir = None
+    out: Dict[str, Any] = {"dir": cache_dir or None,
+                           "entries": 0, "total_bytes": 0}
+    if not cache_dir or not os.path.isdir(cache_dir):
+        return out
+    try:
+        names = os.listdir(cache_dir)
+    except OSError:
+        return out
+    for name in names:
+        try:
+            st = os.stat(os.path.join(cache_dir, name))
+        except OSError:
+            continue
+        if stat.S_ISREG(st.st_mode):
+            out["entries"] += 1
+            out["total_bytes"] += int(st.st_size)
+    return out

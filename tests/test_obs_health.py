@@ -484,10 +484,12 @@ class TestEngineHealth:
         assert all("span_id" in r and "t_end" in r for r in rounds)
         names = {s["name"] for s in spans}
         assert {"train", "comm", "sync", "run"} <= names
-        # the whole file exports to a VALID Chrome trace
+        # the whole file exports to a VALID Chrome trace; compile
+        # records (schema v6, obs/costs.py) export as spans too
+        compiles = [r for r in records if r["event"] == "compile"]
         out = os.path.join(str(tmp_path), "t.json")
         n = obs_trace.export(t.obs_recorder.jsonl_path, out)
-        assert n == len(rounds) + len(spans)
+        assert n == len(rounds) + len(spans) + len(compiles)
 
     def test_invalid_health_knobs_fail_at_construction(self, data):
         with pytest.raises(ValueError, match="health_action"):

@@ -43,9 +43,13 @@ def run_trainer(cfg, data, L=1, **run_kw):
 
 
 def strip(rec):
-    # wall-clock fields legitimately differ between runs
+    # wall-clock and compile/cache-attribution fields legitimately
+    # differ between runs: a resumed process re-compiles at its first
+    # continued round, so cache_hit lands on rounds the uninterrupted
+    # run compiled nothing in (obs/costs.py)
     return {k: v for k, v in rec.items()
-            if isinstance(v, (int, float)) and not k.endswith("_seconds")}
+            if isinstance(v, (int, float)) and not k.endswith("_seconds")
+            and k not in ("cache_hit", "peak_device_bytes")}
 
 
 class TestMidrunResume:
