@@ -60,6 +60,23 @@ class Compressor:
     def decode(self, payload, n: int):
         return payload
 
+    def decode_into(self, payload, scratch):
+        """Dense reconstruction accumulated into a caller-provided ZEROED
+        [n] buffer.  The fused/roofline comm path (ops/packed_reduce.py,
+        train/engine.py) threads a donated scratch through the comm step
+        so sparse decodes reuse one HBM accumulator round after round;
+        the base is zeros either way, so the result is bitwise
+        ``decode(payload, n)``.  Dense compressors ignore the buffer."""
+        return self.decode(payload, scratch.shape[0])
+
+    def transport_params(self):
+        """``(bits, chunk)`` when the payload is fixed-grid chunk-scaled
+        integers the fused collective can re-quantize hop to hop
+        (ops/packed_reduce.py pack_chunks), else ``None`` — the wire
+        contract a transport needs, declared by the compressor itself so
+        the fused path and the codec cannot drift."""
+        return None
+
     def reset_state(self, state):
         """Drop any carried update memory (error-feedback residual) while
         keeping stream state (PRNG keys).  Called by the engine's update

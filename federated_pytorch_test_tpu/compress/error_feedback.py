@@ -40,6 +40,12 @@ class ErrorFeedback(Compressor):
     def decode(self, payload, n: int):
         return self.inner.decode(payload, n)
 
+    def decode_into(self, payload, scratch):
+        return self.inner.decode_into(payload, scratch)
+
+    def transport_params(self):
+        return self.inner.transport_params()
+
     def reset_state(self, state):
         """Quarantine policy (train/engine.py update guards): RESET the
         residual, carry the inner stream state.  The residual of a

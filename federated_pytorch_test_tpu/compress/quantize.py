@@ -62,6 +62,12 @@ class StochasticQuantizer(Compressor):
         v = q.astype(jnp.float32) * payload["scale"][:, None]
         return v.reshape(-1)[:n]
 
+    def transport_params(self):
+        # the payload grid (per-chunk max-abs scale, symmetric +/-qmax
+        # integers) is exactly what the fused collective's hop codec
+        # speaks — declare it (ops/packed_reduce.py pack_chunks)
+        return self.bits, self.chunk
+
     def bytes_on_wire(self, n: int) -> int:
         c = self._chunks(n)
         return c * self.chunk * self.bits // 8 + 4 * c

@@ -42,5 +42,10 @@ class TopK(Compressor):
         return jnp.zeros((n,), payload["val"].dtype).at[
             payload["idx"]].add(payload["val"])
 
+    def decode_into(self, payload, scratch):
+        # scatter-add into the caller's zeroed (donated) buffer: same
+        # math as decode, no fresh [n] zeros materialized per round
+        return scratch.at[payload["idx"]].add(payload["val"])
+
     def bytes_on_wire(self, n: int) -> int:
         return 8 * self.k_for(n)                 # i32 index + f32 value

@@ -65,6 +65,12 @@ def _direction(name: str) -> int:
         return _DIRECTION[name]
     if name.endswith("_ips_chip") or name.endswith("_throughput"):
         return +1
+    # roofline comm-path gate (bench.py --smoke): predicted byte counts
+    # regress UP, compression/savings ratios regress DOWN
+    if name.endswith("_wire_bytes"):
+        return -1
+    if name.endswith("_savings_ratio"):
+        return +1
     return 0        # unknown: report the delta, never a verdict
 
 
@@ -126,7 +132,12 @@ def load_source(path: str) -> Dict[str, Any]:
             if v is not None:
                 src["metrics"][headline] = v
             for k, val in obj.items():
-                if k.endswith("_ips_chip") or k == "mfu":
+                # smoke_* covers bench.py --smoke fields: the *_wire_bytes
+                # ones gate (direction -1), the rest report as info
+                if (k.endswith("_ips_chip") or k == "mfu"
+                        or k.endswith("_wire_bytes")
+                        or k.endswith("_savings_ratio")
+                        or k.startswith("smoke_")):
                     v = _num(val)
                     if v is not None:
                         src["metrics"][k] = v

@@ -66,8 +66,18 @@ from typing import Any, Dict
 # `cache_hit`, and summary compile/cache totals plus the device-memory
 # high-watermark pair.  ALL cost fields are advisory: absent means "the
 # backend/mode did not produce it", never zero (PARITY.md).
-# v1..v5 records remain valid: validate_record accepts ver <= SCHEMA_VERSION.
-SCHEMA_VERSION = 6
+# v7 (additive): the roofline comm path (--fused-collective /
+# --overlap-staging) — per-round `bytes_fused` (predicted device-to-device
+# bytes the fused packed collective moves for the round: every ppermute
+# hop's packed payload + scale sidecar, ops/packed_reduce.py
+# fused_bytes_on_wire; a DIFFERENT quantity from the uplink model
+# `bytes_on_wire`, which counts K client payloads once) and
+# `overlap_seconds` (host wall-clock the round spent pre-staging the next
+# round's first epoch while the comm dispatch was in flight; present only
+# when --overlap-staging is on, 0.0 when there was nothing left to
+# prestage).
+# v1..v6 records remain valid: validate_record accepts ver <= SCHEMA_VERSION.
+SCHEMA_VERSION = 7
 
 EVENTS = ("run_header", "round", "summary", "span", "alert", "compile")
 
@@ -142,6 +152,9 @@ FIELDS: Dict[str, Any] = {
     # communication volume
     "bytes_on_wire": (("round",), _INT),
     "bytes_dense":  (("round",), _INT),
+    # roofline comm path (schema v7; --fused-collective/--overlap-staging)
+    "bytes_fused":  (("round",), _INT),
+    "overlap_seconds": (("round",), _NUM),
     # fault / guard counters
     "guard_trips":  (("round",), _NUM),
     "guard_norm_mean": (("round",), _NUM),

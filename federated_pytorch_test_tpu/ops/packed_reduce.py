@@ -1,0 +1,325 @@
+"""Fused quantized/sparse collectives: compressed payloads stay packed
+across the wire (ISSUE 11 tentpole 1).
+
+The unfused path (`parallel/comm.py:compressed_federated_mean` and the
+engine's decode-then-``global_update`` route) decodes every client's
+payload to dense f32 *before* the ``psum``, so the collective itself
+never benefits from compression — the wire carries ``4*N`` bytes per
+hop regardless of ``--compress``.  This module keeps the reduction
+itself quantized:
+
+- **Dense q8/q4** (:func:`packed_fused_mean`): a recursive-halving
+  (butterfly) reduce-scatter over ``ppermute`` for power-of-2 device
+  counts — each of the ``log2(D)`` steps sends a *packed* int8/int4
+  half-buffer plus per-chunk f32 scales instead of dense f32 — followed
+  by one all-gather of the packed owned shards.  Non-power-of-2 meshes
+  take a ``D-1``-step quantized ring reduce-scatter instead.  Every
+  device decodes the SAME gathered bytes, so the result is replicated
+  by construction (the same argument `robust_federated_mean` relies on
+  for its ``out_specs=P()``).
+- **Sparse top-k** (:func:`make_sparse_fused_mean`): all-gather the
+  fixed-shape ``{idx, val}`` payloads (``8k`` bytes per client) and
+  scatter-add once on every device — never densifying ``[K, N]`` per
+  client before the collective.
+
+The re-quantization at each hop makes the fused dense mean a *lossy*
+transport: it is allclose to the unfused mean, not bitwise (tolerance
+documented in PARITY.md; roughly ``(log2(D)+1)`` grid steps of the
+per-chunk scale for q8).  The transport codec is deliberately
+**deterministic round-to-nearest** — key-free, unlike the stochastic
+client-side encoder — so a fused run is replayable and kill/resume
+exact without threading PRNG state through the collective.
+
+CPU fallback is the same code path: ``ppermute``/``all_gather`` lower
+fine on the virtual CPU mesh, and ``D == 1`` skips collectives
+entirely.  An optional Pallas TPU kernel for the quantize step is
+gated behind ``FEDTPU_FUSED_PALLAS=1`` (off by default; the jnp
+lowering is what tier-1 exercises).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from federated_pytorch_test_tpu.parallel.mesh import CLIENT_AXIS
+
+__all__ = [
+    "transport_params",
+    "pack_chunks",
+    "unpack_chunks",
+    "packed_fused_mean",
+    "make_fused_mean",
+    "make_sparse_fused_mean",
+    "fused_bytes_on_wire",
+]
+
+
+def _inner(compressor):
+    """Look through the ErrorFeedback wrapper to the transport codec."""
+    return getattr(compressor, "inner", None) or compressor
+
+
+def transport_params(compressor) -> Optional[Tuple[int, int]]:
+    """``(bits, chunk)`` of the wire codec matching ``compressor``, or
+    None when it has no dense quantized transport (identity / sparse).
+
+    Prefers the compressor's own declaration
+    (``Compressor.transport_params``, compress/base.py) so the wire
+    contract lives with the codec; falls back to duck-typed (bits,
+    chunk) attributes for third-party compressors."""
+    declared = getattr(compressor, "transport_params", None)
+    if callable(declared):
+        tp = declared()
+        if tp is not None:
+            bits, chunk = tp
+            return int(bits), int(chunk)
+    inner = _inner(compressor)
+    bits = getattr(inner, "bits", None)
+    chunk = getattr(inner, "chunk", None)
+    if bits in (4, 8) and chunk:
+        return int(bits), int(chunk)
+    return None
+
+
+def _use_pallas() -> bool:
+    return (os.environ.get("FEDTPU_FUSED_PALLAS", "0") == "1"
+            and jax.default_backend() == "tpu")
+
+
+def _quantize_rows(vv, safe, qmax):
+    """Round-to-nearest int8 rows ``clip(round(vv/safe), ±qmax)``;
+    Pallas VPU kernel on TPU when opted in, jnp elsewhere."""
+    if _use_pallas():
+        try:
+            return _quantize_rows_pallas(vv, safe, qmax)
+        except Exception:                         # pragma: no cover - TPU only
+            pass                                  # jnp lowering is always valid
+    return jnp.clip(jnp.round(vv / safe[:, None]), -qmax, qmax
+                    ).astype(jnp.int8)
+
+
+def _quantize_rows_pallas(vv, safe, qmax):       # pragma: no cover - TPU only
+    """Single-block elementwise quantize kernel: the divide/round/clip
+    chain stays in VMEM instead of round-tripping HBM between the XLA
+    fusions on either side of the collective."""
+    from jax.experimental import pallas as pl
+
+    def kernel(v_ref, s_ref, o_ref):
+        q = jnp.round(v_ref[...] / s_ref[...])
+        o_ref[...] = jnp.clip(q, -qmax, qmax).astype(jnp.int8)
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(vv.shape, jnp.int8),
+    )(vv, safe[:, None] * jnp.ones((1, vv.shape[1]), vv.dtype))
+
+
+def pack_chunks(v, chunk: int, bits: int):
+    """Deterministic per-chunk transport encode of ``v`` (``[m]`` f32,
+    ``m % chunk == 0``): returns ``(q, scale)`` with the same chunk
+    layout as compress/quantize.py (scale = max|chunk|/qmax, int4
+    payloads nibble-packed two-per-byte)."""
+    qmax = 2 ** (bits - 1) - 1
+    c = v.shape[0] // chunk
+    vv = v.reshape(c, chunk)
+    scale = jnp.max(jnp.abs(vv), axis=1) / qmax
+    safe = jnp.where(scale > 0, scale, 1.0).astype(v.dtype)
+    q = _quantize_rows(vv, safe, qmax)
+    if bits == 4:
+        nib = (q + 8).astype(jnp.uint8)
+        q = (nib[:, 0::2] << 4) | nib[:, 1::2]
+    return q, scale.astype(jnp.float32)
+
+
+def unpack_chunks(q, scale, chunk: int, bits: int):
+    """Inverse of :func:`pack_chunks` → flat ``[c*chunk]`` f32."""
+    if bits == 4:
+        hi = (q >> 4).astype(jnp.int8) - 8
+        lo = (q & 0xF).astype(jnp.int8) - 8
+        q = jnp.stack([hi, lo], axis=-1).reshape(q.shape[0], -1)
+    safe = jnp.where(scale > 0, scale, 1.0)
+    return (q.astype(jnp.float32) * safe[:, None]).reshape(-1)
+
+
+def _seg_elems(n: int, D: int, chunk: int) -> int:
+    """Per-device segment length: N split D ways, rounded up to a whole
+    number of codec chunks so per-chunk scales align at every level."""
+    return -(-n // (D * chunk)) * chunk
+
+
+def _butterfly_reduce_scatter(buf, D: int, seg: int, chunk: int, bits: int,
+                              axis_name: str):
+    """Recursive-halving reduce-scatter over packed payloads (power-of-2
+    ``D``).  Returns ``(buf, lo)`` where ``buf[lo:lo+seg]`` is device
+    ``me``'s fully-reduced segment (``lo == me*seg``)."""
+    me = lax.axis_index(axis_name)
+    lo = jnp.zeros((), jnp.int32)
+    half = D // 2
+    while half >= 1:
+        width = half * seg
+        bit = (me & half) > 0                 # my side of this exchange
+        keep_lo = lo + jnp.where(bit, width, 0)
+        send_lo = lo + jnp.where(bit, 0, width)
+        send = lax.dynamic_slice(buf, (send_lo,), (width,))
+        q, s = pack_chunks(send, chunk, bits)
+        perm = [(i, i ^ half) for i in range(D)]
+        q = lax.ppermute(q, axis_name, perm)
+        s = lax.ppermute(s, axis_name, perm)
+        kept = lax.dynamic_slice(buf, (keep_lo,), (width,))
+        kept = kept + unpack_chunks(q, s, chunk, bits)
+        buf = lax.dynamic_update_slice(buf, kept, (keep_lo,))
+        lo = keep_lo
+        half //= 2
+    return buf, lo
+
+
+def _ring_reduce_scatter(buf, D: int, seg: int, chunk: int, bits: int,
+                         axis_name: str):
+    """Quantized ring reduce-scatter for non-power-of-2 ``D``: ``D-1``
+    neighbor exchanges; device ``me`` ends owning segment
+    ``(me+1) % D``.  Returns ``(buf, own_lo)``."""
+    me = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % D) for i in range(D)]
+    for t in range(D - 1):
+        send_lo = ((me - t) % D) * seg
+        send = lax.dynamic_slice(buf, (send_lo,), (seg,))
+        q, s = pack_chunks(send, chunk, bits)
+        q = lax.ppermute(q, axis_name, perm)
+        s = lax.ppermute(s, axis_name, perm)
+        recv_lo = ((me - 1 - t) % D) * seg
+        acc = lax.dynamic_slice(buf, (recv_lo,), (seg,))
+        acc = acc + unpack_chunks(q, s, chunk, bits)
+        buf = lax.dynamic_update_slice(buf, acc, (recv_lo,))
+    return buf, ((me + 1) % D) * seg
+
+
+def packed_fused_mean(local, div, D: int, bits: int, chunk: int,
+                      axis_name: str = CLIENT_AXIS):
+    """Quantized allreduce-mean of per-device partial sums.
+
+    ``local``: ``[N]`` f32 per-device partial sum; ``div``: replicated
+    divisor (already guarded against zero).  Reduce-scatter ships packed
+    payloads, the divide runs on each device's owned ``[seg]`` shard,
+    the shard is packed ONCE and all-gathered still packed; every device
+    decodes the identical bytes, so the ``[N]`` result is replicated.
+    """
+    n = local.shape[-1]
+    if D == 1:
+        return local / div
+    seg = _seg_elems(n, D, chunk)
+    buf = jnp.pad(local, (0, D * seg - n))
+    if D & (D - 1) == 0:
+        buf, lo = _butterfly_reduce_scatter(buf, D, seg, chunk, bits,
+                                            axis_name)
+        own = lax.dynamic_slice(buf, (lo,), (seg,)) / div
+        q, s = pack_chunks(own, chunk, bits)
+        # butterfly leaves device i owning segment i: tiled gather is
+        # already in segment order
+        qg = lax.all_gather(q, axis_name, tiled=True)
+        sg = lax.all_gather(s, axis_name, tiled=True)
+        return unpack_chunks(qg, sg, chunk, bits)[:n]
+    buf, lo = _ring_reduce_scatter(buf, D, seg, chunk, bits, axis_name)
+    own = lax.dynamic_slice(buf, (lo,), (seg,)) / div
+    q, s = pack_chunks(own, chunk, bits)
+    # ring leaves device i owning segment (i+1)%D: gather untiled and
+    # roll one slot so row j holds segment j before decoding
+    qg = jnp.roll(lax.all_gather(q, axis_name), 1, axis=0)
+    sg = jnp.roll(lax.all_gather(s, axis_name), 1, axis=0)
+    c_seg = seg // chunk
+    return unpack_chunks(qg.reshape((D * c_seg,) + q.shape[1:]),
+                         sg.reshape(D * c_seg), chunk, bits)[:n]
+
+
+def _weighted_local_sum(stack, w, K: int, axis_name: str):
+    """Local numerator + replicated divisor matching
+    ``algorithms._active_mean``: plain ``sum/K`` when ``w`` is None,
+    else ``sum(w*x) / max(psum(sum(w)), 1)``."""
+    if w is None:
+        return jnp.sum(stack, axis=0), jnp.float32(K)
+    local = jnp.sum(w[:, None] * stack, axis=0)
+    n_act = lax.psum(jnp.sum(w), axis_name)
+    return local, jnp.where(n_act > 0, n_act, 1.0)
+
+
+def make_fused_mean(compressor, D: int, K: int,
+                    axis_name: str = CLIENT_AXIS) -> Callable:
+    """``mean_fn(stack, w)`` for ``Algorithm._agg`` that runs the whole
+    aggregation as a quantized fused collective (dense q8/q4 codecs)."""
+    tp = transport_params(compressor)
+    if tp is None:
+        raise ValueError(
+            f"fused collective needs a dense quantized codec; "
+            f"{compressor.name!r} has no (bits, chunk) transport")
+    bits, chunk = tp
+
+    def mean_fn(stack, w):
+        local, div = _weighted_local_sum(stack, w, K, axis_name)
+        return packed_fused_mean(local, div, D, bits, chunk, axis_name)
+
+    return mean_fn
+
+
+def make_sparse_fused_mean(payload, z, K: int,
+                           axis_name: str = CLIENT_AXIS) -> Callable:
+    """Per-round ``mean_fn(stack, w)`` for sparse top-k payloads.
+
+    Valid ONLY when the aggregated stack is ``x = z + decode(payload)``
+    (FedAvg/FedProx — the engine falls back to the unfused path for
+    dual-state algorithms): the closure ignores ``stack`` and rebuilds
+    the mean from the gathered ``{idx, val}`` pairs directly, one
+    scatter-add on every device instead of K dense decodes + psum.
+    NaN hygiene matches the guard contract: corrupted payload rows can
+    hold NaN vals while only ``x`` was neutralized, so excluded rows
+    (``w == 0``) are where-selected out, never multiplied by 0.
+    """
+    idx, val = payload["idx"], payload["val"]
+    n = z.shape[0]
+
+    def mean_fn(stack, w):
+        del stack                              # x is implied by (z, payload)
+        ig = lax.all_gather(idx, axis_name, tiled=True)
+        vg = lax.all_gather(val, axis_name, tiled=True)
+        if w is None:
+            acc = jnp.zeros((n,), vg.dtype)
+            acc = acc.at[ig.reshape(-1)].add(vg.reshape(-1))
+            return z + acc / K
+        wg = lax.all_gather(w, axis_name, tiled=True)
+        vw = jnp.where(wg[:, None] > 0, vg * wg[:, None], 0.0)
+        acc = jnp.zeros((n,), vg.dtype)
+        acc = acc.at[ig.reshape(-1)].add(vw.reshape(-1))
+        total = jnp.sum(wg)
+        # all-excluded rounds zero the aggregate, matching _active_mean's
+        # 0-numerator/1-divisor result (the engine carries z over anyway)
+        return jnp.where(total > 0, z + acc / jnp.where(total > 0, total, 1.0),
+                         0.0)
+
+    return mean_fn
+
+
+def fused_bytes_on_wire(compressor, n: int, D: int, K: int) -> int:
+    """Estimated total wire bytes of one fused aggregation round.
+
+    Dense: butterfly/ring reduce-scatter moves ``(D-1)*seg`` packed
+    elements per device, the all-gather the same again →
+    ``2*D*(D-1)*(seg*bits/8 + 4*seg/chunk)``.  Sparse: the all-gather
+    broadcasts each client's ``8k``-byte payload to the other ``D-1``
+    devices.  ``D == 1`` moves nothing.
+    """
+    if D <= 1:
+        return 0
+    inner = _inner(compressor)
+    if getattr(compressor, "sparse", False):
+        k = inner.k_for(n)
+        return (D - 1) * K * 8 * k
+    tp = transport_params(compressor)
+    if tp is None:
+        return 0
+    bits, chunk = tp
+    seg = _seg_elems(n, D, chunk)
+    per_seg = seg * bits // 8 + 4 * (seg // chunk)
+    return 2 * D * (D - 1) * per_seg

@@ -51,18 +51,26 @@ def federated_mean(tree, K: int, axis_name: str = CLIENT_AXIS):
     return jax.tree.map(lambda x: x / K, federated_sum(tree, axis_name))
 
 
-def decode_stack(payloads, compressor, n: int) -> jnp.ndarray:
+def decode_stack(payloads, compressor, n: int, scratch=None) -> jnp.ndarray:
     """Dense reconstructions [K_local, n] of a client-stacked payload tree.
 
     Every payload leaf carries the local client axis in front (the encode
     side is vmapped the same way), so one vmap of the compressor's decode
     recovers the per-client dense vectors.
+
+    ``scratch`` ([K_local, n], ZEROED) routes sparse decodes through
+    ``Compressor.decode_into`` so the scatter-add accumulates into a
+    caller-owned (typically donated) buffer instead of materializing
+    fresh zeros — bitwise the same result, the base is zeros either way.
     """
+    if scratch is not None:
+        return jax.vmap(compressor.decode_into)(payloads, scratch)
     return jax.vmap(lambda p: compressor.decode(p, n))(payloads)
 
 
 def compressed_federated_mean(payloads, compressor, n: int, K: int,
-                              axis_name: str = CLIENT_AXIS, w=None):
+                              axis_name: str = CLIENT_AXIS, w=None,
+                              scratch=None):
     """Mean over clients of the decoded payloads -> dense [n].
 
     Two reduction shapes, picked by the payload structure:
@@ -76,13 +84,16 @@ def compressed_federated_mean(payloads, compressor, n: int, K: int,
       all-reduce stays one dense vector.
 
     ``w`` ([K_local] activity/weight vector) masks clients out of both the
-    sum and the divisor (partial participation).
+    sum and the divisor (partial participation).  ``scratch`` ([n],
+    ZEROED) supplies the sparse path's dense accumulator base so a caller
+    threading a donated buffer avoids the fresh-zeros materialization.
     """
     if getattr(compressor, "sparse", False):
         val = payloads["val"]
         if w is not None:
             val = val * w[:, None]
-        local = jnp.zeros((n,), val.dtype).at[
+        base = jnp.zeros((n,), val.dtype) if scratch is None else scratch
+        local = base.at[
             payloads["idx"].reshape(-1)].add(val.reshape(-1))
     else:
         d = decode_stack(payloads, compressor, n)
@@ -93,6 +104,48 @@ def compressed_federated_mean(payloads, compressor, n: int, K: int,
     if w is None:
         return total / K
     return total / lax.psum(jnp.sum(w), axis_name)
+
+
+def sharded_federated_mean(stack, w=None, *, K: int, D: int,
+                           axis_name: str = CLIENT_AXIS) -> jnp.ndarray:
+    """Cross-replica sharded server update (arXiv:2004.13336) — the
+    ``--sharded-update`` drop-in for the plain psum mean.
+
+    The replicated formulation makes every device reduce and divide the
+    FULL [N] consensus vector; here each device owns a 1/D segment:
+    ``psum_scatter`` sums while scattering (each device receives only its
+    segment of the global sum), the weighted divide runs on the owned
+    shard, and a tiled ``all_gather`` re-replicates the result for the
+    algorithm updates downstream.  Same wire volume as psum (reduce-
+    scatter + all-gather IS how XLA lowers an all-reduce) but 1/D of the
+    update arithmetic and reduction memory per chip — the win 2004.13336
+    reports for replicated weight-update state, which is exactly what
+    z/y/rho are.  Result is allclose to the replicated mean, NOT bitwise
+    (a different reduction association order); see PARITY.md.
+
+    ``stack`` is the client-stacked [K_local, N] flat block inside
+    ``shard_map``; ``w`` follows the ``_active_mean`` contract
+    (train/algorithms.py): ``None`` divides by ``K``, else by the psum'd
+    weight total with the all-rejected round mapped to the zero vector.
+    """
+    n = stack.shape[-1]
+    if w is None:
+        local = jnp.sum(stack, axis=0)
+        div = jnp.float32(K)
+    else:
+        # all-rejected rounds need no special case: every w row is 0, so
+        # the scattered sum is already the zero vector and div stays 1
+        local = jnp.sum(w[:, None] * stack, axis=0)
+        n_act = lax.psum(jnp.sum(w), axis_name)
+        div = jnp.where(n_act > 0, n_act, 1.0)
+    if D == 1:
+        return local / div
+    seg = -(-n // D)
+    buf = jnp.pad(local, (0, D * seg - n))
+    shard = lax.psum_scatter(buf, axis_name, scatter_dimension=0,
+                             tiled=True)
+    out = lax.all_gather(shard / div, axis_name, tiled=True)
+    return out[:n]
 
 
 def robust_federated_mean(x: jnp.ndarray, w=None, *, kind: str,

@@ -174,6 +174,39 @@ class FederatedConfig:
     # warning.  Off by default (dense CPU tier-1 path unchanged).
     fused_rounds: bool = False
 
+    # fused quantized/sparse collectives (ops/packed_reduce.py): keep the
+    # compressed client payloads PACKED across the aggregation collective
+    # instead of decoding to dense f32 before the psum — q8/q4 run a
+    # quantized butterfly/ring reduce-scatter + packed all-gather, topk
+    # all-gathers the {idx, val} payloads and scatter-adds once per
+    # device.  Requires --compress q8|q4|topk; incompatible with
+    # --robust-agg (both replace the aggregation chokepoint).  The dense
+    # fused mean is allclose to the unfused reference, NOT bitwise (the
+    # wire re-quantizes each hop; tolerance documented in PARITY.md);
+    # topk+ADMM falls back to the unfused reduction with a warning (the
+    # dual aggregate y + rho*x is dense).  Off by default — the unfused
+    # path stays bitwise unchanged.
+    fused_collective: bool = False
+
+    # staging/comm overlap (train/engine.py _prestage_round): build and
+    # stage round N+1's first epoch (batches + PRNG keys, H2D included)
+    # while round N's comm dispatch executes on the device.  Extends
+    # prefetch (which only overlaps the host-side shuffle) to the device
+    # staging; counter-keyed like prefetch, so kill/resume and the math
+    # stay bit-identical on/off.  Off by default; no-op under
+    # fused_rounds (one dispatch, nothing to overlap).
+    overlap_staging: bool = False
+
+    # sharded server update (parallel/comm.py sharded_federated_mean,
+    # arXiv:2004.13336): compute the consensus aggregate via
+    # psum_scatter → per-shard divide → all_gather instead of every
+    # device reducing the full [N] vector — 1/D of the update FLOPs and
+    # reduction memory per chip.  Result is allclose to the replicated
+    # mean, not bitwise (different reduction order).  Incompatible with
+    # --robust-agg; when fused_collective is also on, the fused path
+    # wins (it already divides on the owned shard).  Off by default.
+    sharded_update: bool = False
+
     # buffer donation: pass donate_argnums for the client state and the
     # consensus block vars (z/y/rho/x0/yhat0) on the train/comm/fused
     # round fns so XLA reuses their device buffers in place of fresh

@@ -144,6 +144,33 @@ class BlockwiseFederatedTrainer:
         self.mean_fn = make_robust_mean(cfg.robust_agg,
                                         trim_frac=cfg.trim_frac,
                                         clip_mult=cfg.clip_mult)
+        # roofline comm path (cfg.fused_collective / cfg.sharded_update /
+        # cfg.overlap_staging): validated here like the robust/compress
+        # knobs so a bad flag combination fails at construction
+        self._fused_coll = bool(cfg.fused_collective)
+        if cfg.fused_collective and self.compressor.name == "none":
+            raise ValueError(
+                "fused_collective requires a compressed wire format "
+                "(--compress q8/q4/topk): the fused reduction transports "
+                "the packed payloads, and the dense path has nothing to "
+                "keep packed")
+        if (cfg.fused_collective or cfg.sharded_update) \
+                and cfg.robust_agg != "none":
+            raise ValueError(
+                "fused_collective/sharded_update are incompatible with "
+                "--robust-agg: both replace the aggregation chokepoint, "
+                "and the robust estimators need the full [K, N] stack "
+                "replicated on every device")
+        if (self._fused_coll and getattr(self.compressor, "sparse", False)
+                and algorithm.needs_dual):
+            import warnings
+            warnings.warn(
+                "fused_collective with a sparse compressor is unavailable "
+                "for dual-state algorithms: the aggregated stack y + rho*x "
+                "is dense, not the sparse wire payload; falling back to "
+                "the unfused reduction", stacklevel=2)
+            self._fused_coll = False
+        self._overlap = bool(cfg.overlap_staging)
         if cfg.bb_update and (self.faults.enabled or cfg.update_guard):
             raise ValueError(
                 "fault injection / update guards are incompatible with "
@@ -279,6 +306,13 @@ class BlockwiseFederatedTrainer:
         self._keys_staged = 0
         self._prefetch_epochs = bool(cfg.prefetch)
         self._pending: Optional[tuple] = None
+        # staging/comm overlap (cfg.overlap_staging): (counter, arrays)
+        # built ahead by _prestage_round while the comm dispatch executes;
+        # the counters advance only at CONSUMPTION (_stage_epoch /
+        # _epoch_keys), so checkpoints record consumption state and a
+        # resumed run rebuilds the same epoch from the counter
+        self._staged_ahead: Optional[tuple] = None
+        self._keys_ahead: Optional[tuple] = None
         # buffer donation (cfg.donate; None = auto: accelerators only —
         # CPU honors donation too, but keeping the caller-side arrays
         # alive is the safer default where nobody is memory-bound):
@@ -559,9 +593,38 @@ class BlockwiseFederatedTrainer:
         compressor = self.compressor
         compressed = compressor.name != "none"
         N = self.block_size(ci) if compressed else None
+        # roofline comm path (ops/packed_reduce.py): the fused dense
+        # reduction replaces the aggregation chokepoint outright — the
+        # quantized payload stays packed across every ppermute hop.  The
+        # sparse variant is per-round (it closes over the encoded payload
+        # inside comm_shard below).  sharded_update reuses the same
+        # chokepoint with a psum_scatter/all_gather split; the fused path
+        # wins when both are on (it already divides on the owned shard).
+        fused_dense = (self._fused_coll and compressed
+                       and not getattr(compressor, "sparse", False))
+        fused_sparse = (self._fused_coll and compressed
+                        and getattr(compressor, "sparse", False))
+        if fused_dense:
+            from federated_pytorch_test_tpu.ops.packed_reduce import (
+                make_fused_mean,
+            )
+            mean_fn = make_fused_mean(compressor, self.D, K)
+        elif cfg.sharded_update and mean_fn is None:
+            from federated_pytorch_test_tpu.parallel.comm import (
+                sharded_federated_mean,
+            )
+            mean_fn = functools.partial(sharded_federated_mean,
+                                        K=K, D=self.D)
+        # sparse donated scratch: the top-k dense accumulator [K, N] is a
+        # threaded operand the comm step zeroes and returns, so donation
+        # reuses one HBM buffer round after round instead of
+        # materializing fresh zeros (satellite of the fused-collective
+        # work; base is always zeros, so the math is bitwise unchanged)
+        use_scratch = bool(compressed and getattr(compressor, "sparse",
+                                                  False))
 
         def comm_shard(state: ClientState, z, y, rho, x0, yhat0, active,
-                       corrupt, gbound, mode):
+                       corrupt, gbound, scratch=None, mode=None):
             x = jax.vmap(lambda p: codec.get_trainable_values(p, order, mask))(
                 state.params
             )
@@ -577,6 +640,7 @@ class BlockwiseFederatedTrainer:
                     x - z[None, :], corrupt, corrupt_mode, corrupt_scale,
                     w=active, axis_name=CLIENT_AXIS)
             comp_state = state.comp
+            round_mean = mean_fn
             if compressed:
                 # uplink-compress the update delta d_k = x_k - z; the
                 # "server" sees only x̂_k = z + decode(payload): every
@@ -588,7 +652,15 @@ class BlockwiseFederatedTrainer:
                 )
                 payload, comp_new = jax.vmap(compressor.encode)(
                     x - z[None, :], comp_state)
-                x = z[None, :] + decode_stack(payload, compressor, N)
+                if fused_sparse:
+                    # the k-sized payloads go over the wire themselves
+                    # (all_gather of {idx, val}, one scatter-add per
+                    # device) — the aggregate never ships dense
+                    from federated_pytorch_test_tpu.ops.packed_reduce \
+                        import make_sparse_fused_mean
+                    round_mean = make_sparse_fused_mean(payload, z, K)
+                x = z[None, :] + decode_stack(payload, compressor, N,
+                                              scratch=scratch)
                 if partial:
                     # stragglers' PRNG/residual state stays bit-untouched
                     comp_new = _sel(active, comp_new, comp_state)
@@ -631,7 +703,8 @@ class BlockwiseFederatedTrainer:
                     self.D,
                 )
             znew, ynew, diag = algo.global_update(
-                x, z, y, rho, K, w=w if partial else None, mean_fn=mean_fn)
+                x, z, y, rho, K, w=w if partial else None,
+                mean_fn=round_mean)
             if guard_on:
                 # all-rejected round degrades gracefully: z carries over
                 # (ynew is already a no-op — every ydelta is masked by w)
@@ -656,11 +729,18 @@ class BlockwiseFederatedTrainer:
                 diag["n_active"] = lax.psum(jnp.sum(active), CLIENT_AXIS)
             out_state = ClientState(params, state.batch_stats,
                                     state.opt_state, comp_state)
+            out = (out_state, znew, ynew, rho, x0, yhat0, diag)
             if guard_on:
                 # okf rides back to the host so the round loop can
                 # quarantine the offenders it names
-                return (out_state, znew, ynew, rho, x0, yhat0, diag, okf)
-            return out_state, znew, ynew, rho, x0, yhat0, diag
+                out = out + (okf,)
+            if scratch is not None:
+                # hand the (re-zeroed) accumulator back so donation can
+                # alias it into next round's scratch operand (the fused
+                # executor runs this body without one — fresh zeros base,
+                # bitwise the same math)
+                out = out + (jnp.zeros_like(scratch),)
+            return out
 
         spec_c = P(CLIENT_AXIS)
         spec_r = P()
@@ -688,19 +768,28 @@ class BlockwiseFederatedTrainer:
                     spec_c, spec_r)
         if guard_on:
             comm_out = comm_out + (spec_c,)      # okf verdicts to the host
+        comm_in = (state_specs, spec_r, spec_c, spec_r, spec_c,
+                   spec_c, spec_c, spec_c, spec_r)
+        comm_donate = (0, 1, 2, 3, 4, 5)
+        if use_scratch:
+            # the sparse scratch is operand 9, donated so its HBM is
+            # reused for the zeroed accumulator handed back as the last
+            # output
+            comm_in = comm_in + (spec_c,)
+            comm_out = comm_out + (spec_c,)
+            comm_donate = comm_donate + (9,)
         comm_fns = {}
         for mode in ("plain", "bb_store", "bb"):
             comm_fns[mode] = self._instrument_jit(
                 shard_map(
                     functools.partial(comm_shard, mode=mode),
                     mesh=self.mesh,
-                    in_specs=(state_specs, spec_r, spec_c, spec_r, spec_c,
-                              spec_c, spec_c, spec_c, spec_r),
+                    in_specs=comm_in,
                     out_specs=comm_out,
                     check_vma=False,
                 ),
                 f"comm[{mode},blk={ci}]",
-                donate_argnums=self._donate_argnums((0, 1, 2, 3, 4, 5)))
+                donate_argnums=self._donate_argnums(comm_donate))
 
         def init_opt(params):
             if use_lbfgs:
@@ -1170,11 +1259,12 @@ class BlockwiseFederatedTrainer:
 
         self._dev_gather = jax.jit(gather, out_shardings=(csh, csh))
 
-    def _stage_epoch(self, last: bool = False):
-        # every process builds the same shuffle (seed-deterministic), so on
-        # multi-host each stages only its addressable client shards
-        c = self._epochs_staged
-        self._epochs_staged += 1
+    def _build_epoch(self, c: int, last: bool = False):
+        """Staged device arrays (xb, yb, wb) for epoch counter ``c``.
+
+        Pure in the counter (no counter mutation — ``_stage_epoch`` owns
+        that), so the overlap lookahead (``_prestage_round``) can build
+        epoch ``c`` early and the consumer later accounts for it."""
         if self._dev_gather is not None:
             # device-resident path: per-client permutation keys are the
             # only host->device bytes of the epoch (counter-keyed, so
@@ -1203,15 +1293,65 @@ class BlockwiseFederatedTrainer:
         return (stage_global(xb, sh), stage_global(yb, sh),
                 stage_global(wb, sh))
 
+    def _stage_epoch(self, last: bool = False):
+        # every process builds the same shuffle (seed-deterministic), so on
+        # multi-host each stages only its addressable client shards
+        c = self._epochs_staged
+        self._epochs_staged += 1
+        if self._staged_ahead is not None and self._staged_ahead[0] == c:
+            # overlap lookahead hit (cfg.overlap_staging): this epoch was
+            # staged while the previous round's comm step executed
+            out = self._staged_ahead[1]
+            self._staged_ahead = None
+            return out
+        self._staged_ahead = None
+        return self._build_epoch(c, last)
+
+    def _build_keys(self, c: int):
+        base = jax.random.PRNGKey(self._epoch_seed(c, 1))
+        keys = jax.random.split(base, self.cfg.K)
+        keys = np.asarray(jax.random.key_data(keys))
+        return stage_global(keys, client_sharding(self.mesh))
+
     def _epoch_keys(self):
         """Per-client PRNG keys [K, 2] for this epoch (reparam sampling —
         replaces torch.cuda.FloatTensor.normal_, simple_models.py:292-301)."""
         c = self._keys_staged
         self._keys_staged += 1
-        base = jax.random.PRNGKey(self._epoch_seed(c, 1))
-        keys = jax.random.split(base, self.cfg.K)
-        keys = np.asarray(jax.random.key_data(keys))
-        return stage_global(keys, client_sharding(self.mesh))
+        if self._keys_ahead is not None and self._keys_ahead[0] == c:
+            out = self._keys_ahead[1]
+            self._keys_ahead = None
+            return out
+        self._keys_ahead = None
+        return self._build_keys(c)
+
+    def _prestage_round(self) -> float:
+        """Staging/comm overlap (cfg.overlap_staging): build the NEXT
+        epoch's batches and reparam keys now — the caller invokes this
+        between the comm round's asynchronous dispatch and the blocking
+        diagnostics fetch, so the host shuffle + H2D copy execute while
+        the devices run the collective.  Pure lookahead on the
+        counter-keyed seeds: only consumption (``_stage_epoch`` /
+        ``_epoch_keys``) advances the counters, so checkpoint meta,
+        telemetry counters, and the math are bit-identical with the flag
+        off, and a kill between prestage and consumption resumes exactly
+        (the cache is rebuilt from the counter).  Returns the host
+        seconds spent, 0.0 when there is nothing left to stage."""
+        cfg = self.cfg
+        total = cfg.Nloop * self.L * cfg.Nadmm * cfg.Nepoch
+        c = self._epochs_staged
+        if c >= total or self._staged_ahead is not None:
+            return 0.0
+        # deliberately times dispatch, not execution: overlap_seconds is
+        # the HOST cost of the lookahead (shuffle + H2D enqueue) — a sync
+        # here would serialize the copy against the comm step, which is
+        # exactly what --overlap-staging exists to avoid
+        t0 = time.perf_counter()  # graftlint: disable=JG104
+        self._staged_ahead = (c, self._build_epoch(c, last=c == total - 1))
+        if self._keys_ahead is None:
+            ck = self._keys_staged
+            self._keys_ahead = (ck, self._build_keys(ck))
+        return time.perf_counter() - t0
 
     def init_state(self) -> ClientState:
         """A fresh training state — a deep COPY of the staged init, never
@@ -1239,11 +1379,34 @@ class BlockwiseFederatedTrainer:
             return None
         return stage_tree_global(host, client_sharding(self.mesh))
 
+    def _init_sparse_scratch(self, N: int):
+        """Zeroed [K, N] accumulator the sparse top-k comm step scatters
+        into and hands back re-zeroed — the donated operand that lets XLA
+        reuse one HBM buffer for the dense accumulation every round
+        instead of materializing fresh zeros (``comm_shard``).  ``None``
+        on every non-sparse path so default signatures are untouched."""
+        if not getattr(self.compressor, "sparse", False):
+            return None
+        return stage_global(np.zeros((self.cfg.K, N), np.float32),
+                            client_sharding(self.mesh))
+
     def round_bytes_on_wire(self, N: int, n_active: int) -> int:
         """Uplink bytes this comm round: every participant ships one
         encoded block payload (the dense path ships the f32 block — the
         reference's README.md:2 claim, now measured per round)."""
         return int(n_active) * int(self.compressor.bytes_on_wire(N))
+
+    def round_bytes_fused(self, N: int) -> int:
+        """Predicted device-to-device bytes of the fused collective this
+        round (ops/packed_reduce.py): the packed reduce-scatter +
+        all-gather hop volume for dense q8/q4, the payload all_gather for
+        top-k.  Compare against ``bytes_on_wire`` (the unfused uplink
+        model) in the pareto table."""
+        from federated_pytorch_test_tpu.ops.packed_reduce import (
+            fused_bytes_on_wire,
+        )
+        return int(fused_bytes_on_wire(self.compressor, N, self.D,
+                                       self.cfg.K))
 
     # ------------------------------------------------------------------
     # mid-run checkpoint / resume (SURVEY.md section 5 "actually resumable
@@ -1353,6 +1516,10 @@ class BlockwiseFederatedTrainer:
                 "end-of-run checkpoint instead")
         self._epochs_staged = int(meta["epochs_staged"])
         self._keys_staged = int(meta["keys_staged"])
+        # any overlap lookahead predates the restored counters: drop it —
+        # the counter-keyed seeds rebuild the identical epoch on demand
+        self._staged_ahead = None
+        self._keys_ahead = None
         if self.cfg.update_guard:
             if "quarantine" in meta:
                 self._quarantine = np.asarray(meta["quarantine"], np.int64)
@@ -1446,6 +1613,8 @@ class BlockwiseFederatedTrainer:
         """
         self._prefetch_epochs = False     # no further submits
         self._pending = None
+        self._staged_ahead = None
+        self._keys_ahead = None
         self._stage_pool.shutdown(wait=False, cancel_futures=True)
         # drain the async checkpoint writer so an aborted run's LAST
         # submitted round is still durable on disk (the kill/resume
@@ -1596,6 +1765,12 @@ class BlockwiseFederatedTrainer:
                     continue
                 train_epoch, comm_fns, init_opt = self._build_fns(ci)
                 N = self.block_size(ci)
+                # donated sparse accumulator (top-k only): zeroed [K, N]
+                # buffer the comm step scatters into and hands back
+                # re-zeroed, so one HBM allocation serves every round of
+                # the block.  Not checkpointed — it is zeros between
+                # rounds by construction.
+                scratch = self._init_sparse_scratch(N)
                 nadmm_start = 0
                 if (resume_at is not None and (nloop, ci) == resume_at[:2]
                         and resume_at[3]):
@@ -1647,6 +1822,7 @@ class BlockwiseFederatedTrainer:
                                    if cfg.update_guard else 0)
                         loss_acc = None       # on-device [K] accumulator: the
                         stage_s = 0.0         # host fetch happens ONCE per round
+                        overlap_s = 0.0       # host staging hidden behind comm
                         phase_marks = []      # (name, cat, t0, t1) span bounds
                         dispatch0 = self._host_dispatches
                         run_fused = (self._use_fused and algo.communicates
@@ -1744,10 +1920,26 @@ class BlockwiseFederatedTrainer:
                             t_comm = time.perf_counter()
                             if algo.communicates and n_comm > 0:
                                 mode = self._comm_mode(nadmm)
-                                out = comm_fns[mode](
-                                    state, z, y, rho, x0, yhat0,
-                                    comm_active, corrupt,
-                                    self._round_gbound())
+                                args = (state, z, y, rho, x0, yhat0,
+                                        comm_active, corrupt,
+                                        self._round_gbound())
+                                if scratch is not None:
+                                    args = args + (scratch,)
+                                out = comm_fns[mode](*args)
+                                if self._overlap:
+                                    # the dispatch above is async: stage
+                                    # round N+1's first epoch + keys on
+                                    # the host NOW, before the blocking
+                                    # diag/verdict fetches below drain it
+                                    t_ov = time.perf_counter()
+                                    overlap_s = self._prestage_round()
+                                    if obs.enabled and overlap_s > 0:
+                                        phase_marks.append(
+                                            ("overlap", "phase", t_ov,
+                                             t_ov + overlap_s))
+                                if scratch is not None:
+                                    scratch = out[-1]
+                                    out = out[:-1]
                                 if cfg.update_guard:
                                     (state, z, y, rho, x0, yhat0, diag,
                                      okf) = out
@@ -1800,6 +1992,11 @@ class BlockwiseFederatedTrainer:
                                    comm_seconds=comm_s,
                                    sync_seconds=sync_s,
                                    **fcounts, **diag)
+                        if self._overlap:
+                            # host staging seconds hidden behind the comm
+                            # dispatch (schema v7) — 0.0 on fused rounds
+                            # and whenever the lookahead had nothing to do
+                            rec["overlap_seconds"] = overlap_s
                         # train-phase dispatches this round: Nepoch on the
                         # per-epoch loop, exactly 1 when fused — the
                         # tentpole's tracked metric
@@ -1826,6 +2023,11 @@ class BlockwiseFederatedTrainer:
                         if algo.communicates:
                             rec["bytes_on_wire"] = self.round_bytes_on_wire(
                                 N, diag.get("n_active", cfg.K))
+                            if self._fused_coll:
+                                # predicted device-to-device bytes of the
+                                # fused collective (schema v7; additive —
+                                # absent whenever the flag is off)
+                                rec["bytes_fused"] = self.round_bytes_fused(N)
                         if cfg.check_results:
                             rec["accuracy"] = self.evaluate(state)
                         history.append(rec)

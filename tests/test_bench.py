@@ -25,23 +25,35 @@ class TestAcquireBackend:
         monkeypatch.setattr(bench.subprocess, "run",
                             lambda *a, **kw: calls.append(a) or R())
         monkeypatch.delenv("FEDTPU_BENCH_FORCE_CPU", raising=False)
+        monkeypatch.delenv("FEDTPU_BENCH_PRECHECK_TIMEOUT_S", raising=False)
         before = os.environ.get("JAX_PLATFORMS")
         assert bench._acquire_backend() == (None, 1)
-        assert len(calls) == 1
+        assert len(calls) == 2               # health pre-check + one probe
         assert os.environ.get("JAX_PLATFORMS") == before
+        assert bench._RELAY_STATUS["state"] == "healthy"
+        assert bench._RELAY_STATUS["precheck"] == "ok"
 
     def test_probe_retry_is_bounded_and_falls_back_to_cpu(self, monkeypatch):
-        """A wedged relay hangs the probe subprocess; the loop must stop
-        after ``attempts`` tries, back off in between, and force the CPU
-        platform so the artifact still gets emitted."""
-        sleeps = []
+        """Pre-check answers (relay alive enough to import jax) but every
+        FULL probe hangs: the loop must stop after ``attempts`` tries,
+        back off in between, and force the CPU platform so the artifact
+        still gets emitted."""
+        sleeps, calls = [], []
 
-        def hang(*a, **kw):
+        class Ok:
+            returncode = 0
+            stderr = ""
+
+        def run(*a, **kw):
+            calls.append(a)
+            if len(calls) == 1:              # health pre-check passes
+                return Ok()
             raise subprocess.TimeoutExpired(cmd="probe", timeout=kw["timeout"])
 
-        monkeypatch.setattr(bench.subprocess, "run", hang)
+        monkeypatch.setattr(bench.subprocess, "run", run)
         monkeypatch.setattr(bench.time, "sleep", sleeps.append)
         monkeypatch.delenv("FEDTPU_BENCH_FORCE_CPU", raising=False)
+        monkeypatch.delenv("FEDTPU_BENCH_PRECHECK_TIMEOUT_S", raising=False)
         monkeypatch.setenv("JAX_PLATFORMS", "tpu")          # restored after
         monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "1.2.3.4")
         err, used = bench._acquire_backend(attempts=3, probe_timeout=0.5,
@@ -51,6 +63,33 @@ class TestAcquireBackend:
         assert sleeps == [7.0, 14.0]       # exponential, between probes
         assert os.environ["JAX_PLATFORMS"] == "cpu"
         assert os.environ["PALLAS_AXON_POOL_IPS"] == ""
+        assert bench._RELAY_STATUS["state"] == "unavailable"
+        assert bench._RELAY_STATUS["precheck"] == "ok"
+
+    def test_wedged_precheck_short_circuits_to_cpu(self, monkeypatch):
+        """The r03-r05 wedge hangs even a bare ``import jax`` subprocess;
+        the pre-check must catch that in ITS short budget and fall back
+        to CPU immediately — no 75s probes, no backoff sleeps — with a
+        structured ``wedged`` verdict for the artifact."""
+        sleeps = []
+
+        def hang(*a, **kw):
+            raise subprocess.TimeoutExpired(cmd="probe", timeout=kw["timeout"])
+
+        monkeypatch.setattr(bench.subprocess, "run", hang)
+        monkeypatch.setattr(bench.time, "sleep", sleeps.append)
+        monkeypatch.delenv("FEDTPU_BENCH_FORCE_CPU", raising=False)
+        monkeypatch.delenv("FEDTPU_BENCH_PRECHECK_TIMEOUT_S", raising=False)
+        monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "1.2.3.4")
+        err, used = bench._acquire_backend(attempts=3, probe_timeout=0.5,
+                                           backoff=7.0)
+        assert "pre-check hung" in err
+        assert used == 0 and sleeps == []    # probe loop never entered
+        assert os.environ["JAX_PLATFORMS"] == "cpu"
+        assert os.environ["PALLAS_AXON_POOL_IPS"] == ""
+        assert bench._RELAY_STATUS["state"] == "wedged"
+        assert bench._RELAY_STATUS["precheck"] == "hung"
 
     def test_force_cpu_env_skips_probe(self, monkeypatch):
         monkeypatch.setattr(
@@ -60,6 +99,7 @@ class TestAcquireBackend:
         err, used = bench._acquire_backend()
         assert "FEDTPU_BENCH_FORCE_CPU" in err
         assert used == 0                     # no probe ever ran
+        assert bench._RELAY_STATUS["state"] == "skipped"
 
 
 class TestArtifact:
@@ -80,9 +120,29 @@ class TestArtifact:
         assert len(lines) == 1, r.stdout
         art = json.loads(lines[0])
         for key in ("metric", "value", "unit", "vs_baseline", "error",
-                    "relay_attempts"):
+                    "relay_attempts", "relay_status"):
             assert key in art
         assert art["unit"] == "images/sec/chip"
+        assert art["relay_status"]["state"] == "skipped"
+
+    def test_relay_status_synthesized_when_acquire_is_stubbed(
+            self, monkeypatch, capsys):
+        """External drivers (and these tests) monkeypatch _acquire_backend
+        with a plain (err, probes) stub that never touches _RELAY_STATUS;
+        main() must still ship a structured relay_status synthesized from
+        the 2-tuple so the artifact contract holds unconditionally."""
+        monkeypatch.delenv("FEDTPU_BENCH_MEASURE_ON_CPU", raising=False)
+        monkeypatch.setattr(bench, "_acquire_backend",
+                            lambda: ("relay wedged", 3))
+        monkeypatch.setattr(bench, "_run_measurement",
+                            lambda out: pytest.fail("unreachable on error"))
+        monkeypatch.setattr(bench, "_last_measured_artifact", lambda: None)
+        bench.main()
+        art = json.loads(capsys.readouterr().out.strip())
+        assert art["relay_status"]["state"] == "unavailable"
+        assert art["relay_status"]["probes_used"] == 3
+        assert art["relay_status"]["last_error"] == "relay wedged"
+        assert art["measured"] is False and art["value"] == 0.0
 
     def test_measure_failure_still_emits(self, monkeypatch, capsys):
         """An exception mid-measurement must not kill the artifact."""

@@ -142,6 +142,21 @@ class TestFusedEquivalence:
             assert rounds, rec
             assert all(r["host_dispatches"] == ref for r in rounds)
 
+    @pytest.mark.fusedcomm
+    def test_fused_collective_composes_bitwise(self, data):
+        # --fused-rounds is execution-shape only, so it must stay
+        # bit-identical even when the round's comm step is the packed
+        # quantized collective (--compress q8 --fused-collective)
+        kw = dict(compress="q8", fused_collective=True)
+        _, s_plain, h_plain = run_trainer(small_cfg(**kw), data)
+        _, s_fc, h_fc = run_trainer(small_cfg(fused_rounds=True, **kw),
+                                    data)
+        for a, b in zip(param_leaves(s_plain), param_leaves(s_fc)):
+            np.testing.assert_array_equal(a, b)
+        for ra, rb in zip(h_plain, h_fc):
+            assert ra["loss"] == rb["loss"]
+            assert ra["bytes_fused"] == rb["bytes_fused"] > 0
+
     def test_fused_with_donation_matches_too(self, data):
         # the production TPU configuration: fused + donated, still
         # bit-identical to the plain undonated loop

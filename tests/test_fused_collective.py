@@ -1,0 +1,437 @@
+"""Roofline comm path (ISSUE 11): fused quantized/sparse collectives
+(``--fused-collective``), staging/comm overlap (``--overlap-staging``),
+the sharded server update (``--sharded-update``), and the ``bench.py
+--smoke`` CI gate.
+
+Unit layer: the deterministic transport codec and the butterfly/ring
+reduce-scatter against host-side references on the virtual CPU mesh.
+Engine layer: fused vs unfused equivalence within the PARITY.md
+tolerance band, bitwise off-path invariance, telemetry, and validation.
+"""
+
+import json
+import os
+import shutil
+import sys
+import warnings
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from federated_pytorch_test_tpu.compress import (
+    ErrorFeedback,
+    StochasticQuantizer,
+    TopK,
+    make_compressor,
+)
+from federated_pytorch_test_tpu.data.cifar10 import FederatedCifar10
+from federated_pytorch_test_tpu.models.base import (
+    BlockModule,
+    elu,
+    flatten,
+    max_pool_2x2,
+    pairs,
+)
+from federated_pytorch_test_tpu.ops.packed_reduce import (
+    fused_bytes_on_wire,
+    make_fused_mean,
+    make_sparse_fused_mean,
+    pack_chunks,
+    transport_params,
+    unpack_chunks,
+)
+from federated_pytorch_test_tpu.parallel.mesh import (
+    CLIENT_AXIS,
+    client_mesh,
+    client_sharding,
+    shard_map,
+)
+from federated_pytorch_test_tpu.train import (
+    AdmmConsensus,
+    BlockwiseFederatedTrainer,
+    FedAvg,
+    FederatedConfig,
+)
+
+pytestmark = pytest.mark.fusedcomm
+
+P = jax.sharding.PartitionSpec
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# transport codec units
+
+
+class TestTransportCodec:
+    @pytest.mark.parametrize("bits", [8, 4])
+    def test_roundtrip_error_within_half_grid_step(self, bits):
+        rng = np.random.default_rng(0)
+        v = jnp.asarray(rng.normal(size=(512,)).astype(np.float32))
+        q, scale = pack_chunks(v, 128, bits)
+        d = unpack_chunks(q, scale, 128, bits)
+        # round-to-nearest: |err| <= scale/2 per chunk
+        err = np.abs(np.asarray(d - v)).reshape(4, 128).max(axis=1)
+        assert (err <= np.asarray(scale) / 2 + 1e-7).all()
+
+    def test_deterministic_and_keyless(self):
+        # the transport is round-to-nearest, NOT the stochastic client
+        # codec: identical input -> identical bytes, no PRNG state
+        rng = np.random.default_rng(1)
+        v = jnp.asarray(rng.normal(size=(256,)).astype(np.float32))
+        q1, s1 = pack_chunks(v, 64, 8)
+        q2, s2 = pack_chunks(v, 64, 8)
+        np.testing.assert_array_equal(np.asarray(q1), np.asarray(q2))
+        np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+
+    def test_int4_nibble_packing_halves_payload(self):
+        v = jnp.asarray(np.random.default_rng(2).normal(
+            size=(128,)).astype(np.float32))
+        q, _ = pack_chunks(v, 64, 4)
+        assert q.dtype == jnp.uint8 and q.shape == (2, 32)
+
+    def test_zero_chunk_safe(self):
+        v = jnp.zeros((64,), jnp.float32)
+        q, scale = pack_chunks(v, 64, 8)
+        d = unpack_chunks(q, scale, 64, 8)
+        np.testing.assert_array_equal(np.asarray(d), np.zeros(64))
+
+    def test_transport_params_declared_by_codec(self):
+        assert transport_params(StochasticQuantizer(8, 128)) == (8, 128)
+        assert transport_params(
+            ErrorFeedback(StochasticQuantizer(4, 64))) == (4, 64)
+        assert transport_params(TopK(0.1)) is None
+        assert transport_params(make_compressor("none")) is None
+
+    def test_fused_bytes_model(self):
+        q8 = make_compressor("q8", quant_chunk=256)
+        # D=1 moves nothing; the committed smoke-baseline geometry pins
+        # the dense model; sparse is (D-1) broadcast copies of 8k bytes
+        assert fused_bytes_on_wire(q8, 8192, 1, 8) == 0
+        assert fused_bytes_on_wire(q8, 8192, 8, 8) == 116480
+        topk = make_compressor("topk", topk_frac=0.01)
+        k = topk.k_for(8192)
+        assert fused_bytes_on_wire(topk, 8192, 8, 16) == 7 * 16 * 8 * k
+
+
+# ---------------------------------------------------------------------------
+# collective units on the virtual CPU mesh
+
+
+def _ref_mean(stack, w):
+    if w is None:
+        return stack.mean(axis=0)
+    tot = w.sum()
+    num = (w[:, None] * stack).sum(axis=0)
+    return num / (tot if tot > 0 else 1.0)
+
+
+def _run_fused_mean(comp, D, K, n, w=None, seed=0):
+    mesh = client_mesh(D)
+    csh = client_sharding(mesh)
+    rng = np.random.default_rng(seed)
+    stack = rng.normal(size=(K, n)).astype(np.float32)
+    mean_fn = make_fused_mean(comp, D, K)
+    if w is None:
+        fn = shard_map(lambda s: mean_fn(s, None), mesh=mesh,
+                       in_specs=(P(CLIENT_AXIS),), out_specs=P(),
+                       check_vma=False)
+        out = jax.jit(fn)(jax.device_put(jnp.asarray(stack), csh))
+    else:
+        fn = shard_map(mean_fn, mesh=mesh,
+                       in_specs=(P(CLIENT_AXIS), P(CLIENT_AXIS)),
+                       out_specs=P(), check_vma=False)
+        out = jax.jit(fn)(jax.device_put(jnp.asarray(stack), csh),
+                          jax.device_put(jnp.asarray(w, jnp.float32), csh))
+    return np.asarray(out), stack
+
+
+class TestPackedFusedMean:
+    @pytest.mark.parametrize("bits,atol", [(8, 0.05), (4, 0.4)])
+    def test_butterfly_matches_dense_mean(self, bits, atol):
+        # D=8 (power of 2) takes the recursive-halving path; n chosen to
+        # exercise segment padding (1000 -> seg 256 at chunk 256)
+        comp = StochasticQuantizer(bits=bits, chunk=256)
+        out, stack = _run_fused_mean(comp, D=8, K=16, n=1000)
+        np.testing.assert_allclose(out, _ref_mean(stack, None),
+                                   rtol=0, atol=atol)
+
+    def test_ring_matches_dense_mean(self):
+        # D=6 (not a power of 2) takes the D-1-step quantized ring
+        comp = StochasticQuantizer(bits=8, chunk=64)
+        out, stack = _run_fused_mean(comp, D=6, K=12, n=777)
+        np.testing.assert_allclose(out, _ref_mean(stack, None),
+                                   rtol=0, atol=0.05)
+
+    def test_weighted_partial_activity(self):
+        comp = StochasticQuantizer(bits=8, chunk=128)
+        w = np.array([1, 0, 1, 1, 0, 1, 1, 1], np.float32)
+        out, stack = _run_fused_mean(comp, D=8, K=8, n=500, w=w)
+        np.testing.assert_allclose(out, _ref_mean(stack, w),
+                                   rtol=0, atol=0.05)
+
+    def test_all_excluded_round_yields_zero(self):
+        # _active_mean contract: zero numerator over max(total, 1)
+        comp = StochasticQuantizer(bits=8, chunk=128)
+        w = np.zeros((8,), np.float32)
+        out, _ = _run_fused_mean(comp, D=8, K=8, n=500, w=w)
+        np.testing.assert_array_equal(out, np.zeros(500, np.float32))
+
+
+class TestSparseFusedMean:
+    K, n = 8, 400
+
+    def _run(self, w=None, poison_row=None):
+        comp = TopK(frac=0.1)
+        rng = np.random.default_rng(3)
+        vecs = rng.normal(size=(self.K, self.n)).astype(np.float32)
+        z = rng.normal(size=(self.n,)).astype(np.float32)
+        enc = jax.vmap(lambda v: comp.encode(v, None)[0])(jnp.asarray(vecs))
+        idx, val = np.array(enc["idx"]), np.array(enc["val"])
+        if poison_row is not None:
+            val[poison_row] = np.nan       # corrupt payload, w excludes it
+        mesh = client_mesh(8)
+        csh = client_sharding(mesh)
+        zj = jnp.asarray(z)
+
+        def f(ig, vg, wg):
+            mf = make_sparse_fused_mean({"idx": ig, "val": vg}, zj, self.K)
+            return mf(None, wg if w is not None else None)
+
+        fn = shard_map(f, mesh=mesh, in_specs=(P(CLIENT_AXIS),) * 3,
+                       out_specs=P(), check_vma=False)
+        wj = jnp.asarray(w if w is not None
+                         else np.ones(self.K), jnp.float32)
+        out = np.asarray(jax.jit(fn)(
+            jax.device_put(jnp.asarray(idx), csh),
+            jax.device_put(jnp.asarray(val), csh),
+            jax.device_put(wj, csh)))
+        dec = np.stack([np.asarray(comp.decode(
+            {"idx": jnp.asarray(idx[i]), "val": jnp.asarray(val[i])},
+            self.n)) for i in range(self.K)])
+        return out, z[None, :] + dec
+
+    def test_unweighted_matches_dense_decode_mean(self):
+        out, x = self._run()
+        np.testing.assert_allclose(out, x.mean(axis=0), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_weighted_excludes_nan_payload(self):
+        # guard semantics: only x was neutralized on the unfused path, so
+        # the fused closure must where-select excluded rows, never
+        # multiply NaN by 0
+        w = np.array([1, 1, 0, 1, 1, 1, 1, 1], np.float32)
+        out, x = self._run(w=w, poison_row=2)
+        assert np.isfinite(out).all()
+        ref = _ref_mean(np.where(np.isnan(x), 0.0, x), w)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# engine integration
+
+
+class TinyNet(BlockModule):
+    @nn.compact
+    def __call__(self, x, train=True):
+        x = max_pool_2x2(elu(nn.Conv(4, (5, 5), strides=(2, 2),
+                                     name="conv1")(x)))
+        x = flatten(x)
+        return nn.Dense(10, name="fc1")(x)
+
+    def param_order(self):
+        return pairs("conv1", "fc1")
+
+    def train_order_block_ids(self):
+        return [[0, 1], [2, 3]]
+
+    def linear_layer_ids(self):
+        return [1]
+
+
+K = 4
+
+
+@pytest.fixture(scope="module")
+def data():
+    return FederatedCifar10(K=K, batch=16, limit_per_client=32,
+                            limit_test=32)
+
+
+def _cfg(**kw):
+    base = dict(K=K, Nloop=1, Nepoch=1, Nadmm=2, default_batch=16,
+                check_results=False, admm_rho0=0.1, seed=5)
+    base.update(kw)
+    return FederatedConfig(**base)
+
+
+def _run(cfg, data, algo=None):
+    t = BlockwiseFederatedTrainer(TinyNet(), cfg, data,
+                                  algo or AdmmConsensus())
+    t.L = 1
+    state, hist = t.run(log=lambda m: None)
+    return t, state, hist
+
+
+def _leaves(state):
+    return [np.asarray(x) for x in jax.tree.leaves(state.params)]
+
+
+class TestEngineFusedCollective:
+    def test_q8_fused_matches_unfused_within_band(self, data):
+        _, s_u, h_u = _run(_cfg(compress="q8"), data)
+        t, s_f, h_f = _run(_cfg(compress="q8", fused_collective=True), data)
+        for a, b in zip(_leaves(s_u), _leaves(s_f)):
+            np.testing.assert_allclose(a, b, rtol=0, atol=5e-2)
+        # telemetry: bytes_fused present only on the fused run, matches
+        # the byte model, and measures a different quantity than the
+        # uplink model bytes_on_wire
+        N = t.block_size(0)
+        assert h_f[0]["bytes_fused"] == t.round_bytes_fused(N) > 0
+        assert h_f[0]["bytes_on_wire"] == h_u[0]["bytes_on_wire"]
+        assert "bytes_fused" not in h_u[0]
+
+    def test_topk_fused_matches_unfused(self, data):
+        _, s_u, _ = _run(_cfg(compress="topk", topk_frac=0.05), data,
+                         FedAvg())
+        _, s_f, h_f = _run(_cfg(compress="topk", topk_frac=0.05,
+                                fused_collective=True), data, FedAvg())
+        for a, b in zip(_leaves(s_u), _leaves(s_f)):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+        assert h_f[0]["bytes_fused"] > 0
+
+    def test_admm_topk_falls_back_bitwise_with_warning(self, data):
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            _, s_f, h_f = _run(_cfg(compress="topk", topk_frac=0.05,
+                                    error_feedback=True,
+                                    fused_collective=True), data)
+        assert any("dual-state" in str(x.message) for x in w)
+        _, s_u, _ = _run(_cfg(compress="topk", topk_frac=0.05,
+                              error_feedback=True), data)
+        for a, b in zip(_leaves(s_u), _leaves(s_f)):
+            np.testing.assert_array_equal(a, b)
+        assert "bytes_fused" not in h_f[0]
+
+    def test_fused_without_compress_raises(self, data):
+        with pytest.raises(ValueError, match="compressed wire format"):
+            _run(_cfg(fused_collective=True), data)
+
+    def test_fused_with_robust_agg_raises(self, data):
+        with pytest.raises(ValueError, match="robust"):
+            _run(_cfg(compress="q8", fused_collective=True,
+                      robust_agg="trim"), data)
+
+
+class TestEngineShardedUpdate:
+    def test_sharded_update_matches_replicated(self, data):
+        _, s_s, _ = _run(_cfg(sharded_update=True), data)
+        _, s_r, _ = _run(_cfg(), data)
+        # psum_scatter -> all_gather reassociates the sum: allclose, not
+        # bitwise (PARITY.md)
+        for a, b in zip(_leaves(s_s), _leaves(s_r)):
+            np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6)
+
+
+class TestEngineOverlapStaging:
+    def test_overlap_is_bitwise_invisible(self, data):
+        _, s0, h0 = _run(_cfg(), data)
+        _, s1, h1 = _run(_cfg(overlap_staging=True), data)
+        for a, b in zip(_leaves(s0), _leaves(s1)):
+            np.testing.assert_array_equal(a, b)
+        for ra, rb in zip(h0, h1):
+            assert ra["loss"] == rb["loss"]
+        assert "overlap_seconds" in h1[0] and "overlap_seconds" not in h0[0]
+
+    def test_overlap_composes_with_fused_collective(self, data):
+        _, s0, _ = _run(_cfg(compress="q8", fused_collective=True), data)
+        _, s1, h1 = _run(_cfg(compress="q8", fused_collective=True,
+                              overlap_staging=True), data)
+        for a, b in zip(_leaves(s0), _leaves(s1)):
+            np.testing.assert_array_equal(a, b)
+        assert h1[0]["bytes_fused"] > 0
+
+
+# ---------------------------------------------------------------------------
+# bench --smoke gate
+
+
+class TestSmokeGate:
+    def _bench(self):
+        sys.path.insert(0, REPO)
+        import bench
+        return bench
+
+    def test_smoke_gate_passes_against_committed_baseline(
+            self, tmp_path, monkeypatch, capsys):
+        bench = self._bench()
+        (tmp_path / "artifacts").mkdir()
+        shutil.copy(os.path.join(REPO, "artifacts", "SMOKE_BASELINE.json"),
+                    tmp_path / "artifacts")
+        monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+        assert bench._smoke() == 0
+        art = json.load(open(tmp_path / "artifacts" / "smoke.json"))
+        # q8 fused moves ~bits/32 of the dense collective's bytes (plus
+        # the scale sidecar): the headline ratio must stay near 4x
+        assert art["value"] > 3.5
+        assert art["smoke_engine_fused_wire_bytes"] > 0
+        capsys.readouterr()
+
+    def test_smoke_without_baseline_skips_gate(self, tmp_path, monkeypatch,
+                                               capsys):
+        bench = self._bench()
+        monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+        assert bench._smoke() == 0
+        assert "smoke gate skipped" in capsys.readouterr().err
+
+    def test_wire_bytes_regression_trips_compare(self, tmp_path):
+        from federated_pytorch_test_tpu.obs import compare
+
+        base = {"metric": "smoke_fused_q8_wire_savings_ratio", "value": 4.0,
+                "unit": "x", "measured": True,
+                "smoke_fused_q8_wire_bytes": 100000}
+        bp = tmp_path / "base.json"
+        bp.write_text(json.dumps(base))
+        worse = tmp_path / "worse.json"
+        worse.write_text(json.dumps(dict(
+            base, value=2.6, smoke_fused_q8_wire_bytes=150000)))
+        same = tmp_path / "same.json"
+        same.write_text(json.dumps(base))
+        import contextlib
+        import io
+        with contextlib.redirect_stdout(io.StringIO()):
+            assert compare.main([str(worse), "--baseline", str(bp)]) == 1
+            assert compare.main([str(same), "--baseline", str(bp)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# schema v7
+
+
+class TestSchemaV7:
+    def test_round_accepts_fused_fields(self):
+        from federated_pytorch_test_tpu.obs.schema import (
+            SCHEMA_VERSION,
+            validate_record,
+        )
+
+        assert SCHEMA_VERSION >= 7
+        validate_record({"event": "round", "schema": 7, "run_id": "r",
+                         "round_index": 0, "engine": "blockwise",
+                         "round_seconds": 0.1, "bytes_fused": 123,
+                         "overlap_seconds": 0.01})
+
+    def test_bytes_fused_type_checked(self):
+        from federated_pytorch_test_tpu.obs.schema import (
+            SchemaError,
+            validate_record,
+        )
+
+        with pytest.raises(SchemaError):
+            validate_record({"event": "round", "schema": 7, "run_id": "r",
+                             "round_index": 0, "engine": "blockwise",
+                             "round_seconds": 0.1, "bytes_fused": "lots"})

@@ -127,14 +127,21 @@ class TestMidrunResume:
             np.testing.assert_allclose(a["loss"], b["loss"], rtol=1e-5)
 
     @pytest.mark.parametrize("comp_kw", [
-        dict(compress="q8"),
-        dict(compress="topk", topk_frac=0.1, error_feedback=True),
-    ], ids=["q8", "topk_ef"])
+        pytest.param(dict(compress="q8"), id="q8"),
+        pytest.param(dict(compress="topk", topk_frac=0.1,
+                          error_feedback=True), id="topk_ef"),
+        pytest.param(dict(compress="q8", fused_collective=True),
+                     marks=pytest.mark.fusedcomm, id="q8_fused"),
+        pytest.param(dict(compress="q8", overlap_staging=True),
+                     marks=pytest.mark.fusedcomm, id="q8_overlap"),
+    ])
     def test_compressed_state_resumes_identically(self, data, tmp_path,
                                                   comp_kw):
         # the per-client compressor state (PRNG key / EF residual) rides
         # in the midrun checkpoint: a resumed compressed run must replay
-        # the uninterrupted trajectory exactly
+        # the uninterrupted trajectory exactly — including through the
+        # packed-collective comm path and the prestage-overlap cache
+        # (both are keyed on round counters, so resume re-derives them)
         cfg = small_cfg(**comp_kw)
         ck = str(tmp_path / "ck")
         _, hist_full = run_trainer(cfg, data)
