@@ -1,0 +1,299 @@
+"""Replay CLI: re-derive control decisions from a recorded stream.
+
+``python -m federated_pytorch_test_tpu.control.replay run.jsonl`` reads
+an obs JSONL artifact, rebuilds the :class:`~.policy.ControlPolicy`
+from each segment's run-header ``config`` snapshot, feeds the segment's
+round and alert records through it IN FILE ORDER, and diffs the derived
+decision sequence against the recorded ``control`` records.  Supervisor
+records are checked too: the seeded backoff of every ``restart`` record
+is recomputed from (``restart_backoff``, ``seed``, ``attempt``) and the
+attempt numbers must count up from 1.
+
+Exit 0 when every recorded decision is reproduced bit-exactly; exit 1
+(with a diff) on any divergence — the determinism contract of the
+control plane (PARITY.md).  This works because decisions are pure
+functions of the recorded telemetry + round index: no wall clock, no
+randomness, no device state outside the stream.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+from federated_pytorch_test_tpu.control.policy import (
+    Controller, ControlPolicy)
+
+#: decision-content fields replay compares (mode/applied are engine-side
+#: facts — whether the knob was actually turned — not decision content)
+_COMPARE_FIELDS = ("round_index", "intervention", "param", "from_value",
+                   "to_value", "scope", "reason", "observed", "threshold",
+                   "streak")
+
+
+def _decision_key(rec: Dict[str, Any]) -> Tuple:
+    return tuple(rec.get(k) for k in _COMPARE_FIELDS)
+
+
+def _fmt(rec: Dict[str, Any]) -> str:
+    return ", ".join(f"{k}={rec.get(k)!r}" for k in _COMPARE_FIELDS
+                     if rec.get(k) is not None)
+
+
+def segment_stream(records: List[Dict[str, Any]]
+                   ) -> List[List[Dict[str, Any]]]:
+    """Split a (possibly multi-segment) stream at run_header records.
+
+    Supervisor records appended after a dead segment's summary belong to
+    that segment (they are written between the summary and the next
+    header), which this split preserves.  Records before the first
+    header (none in practice) form a headerless leading segment.
+    """
+    segments: List[List[Dict[str, Any]]] = []
+    cur: List[Dict[str, Any]] = []
+    for rec in records:
+        if rec.get("event") == "run_header" and cur:
+            segments.append(cur)
+            cur = []
+        cur.append(rec)
+    if cur:
+        segments.append(cur)
+    return segments
+
+
+def derive_segment_decisions(segment: List[Dict[str, Any]]
+                             ) -> Optional[List[Dict[str, Any]]]:
+    """Policy decisions this segment's telemetry implies, in order.
+
+    Returns None when the segment ran with ``control == "off"`` (or
+    predates the control plane): no policy existed, so no decisions can
+    be derived — any recorded policy record in such a segment is a
+    divergence the caller reports.
+    """
+    header = next((r for r in segment
+                   if r.get("event") == "run_header"), None)
+    config = (header or {}).get("config")
+    if not isinstance(config, dict):
+        return None
+    mode = config.get("control", "off")
+    if mode not in ("observe", "act"):
+        return None
+    # Controller (not bare policy) so exception-swallowing matches the
+    # in-run path exactly; no recorder attached — we only want .records
+    ctl = Controller(ControlPolicy.from_config(config), mode=mode,
+                     can_restart=True)
+    for rec in segment:
+        if rec.get("event") in ("round", "alert"):
+            ctl.observe(rec)
+    return ctl.records
+
+
+def check_policy_records(segments: List[List[Dict[str, Any]]],
+                         errors: List[str]) -> int:
+    """Diff derived vs recorded policy decisions per segment."""
+    checked = 0
+    for si, segment in enumerate(segments):
+        recorded = [r for r in segment if r.get("event") == "control"
+                    and r.get("source") == "policy"]
+        derived = derive_segment_decisions(segment)
+        if derived is None:
+            if recorded:
+                errors.append(
+                    f"segment {si}: {len(recorded)} policy control "
+                    "record(s) but the header config has control off "
+                    "(or no config snapshot) — cannot have been "
+                    "produced by this configuration")
+            continue
+        checked += len(recorded)
+        for i in range(max(len(derived), len(recorded))):
+            if i >= len(derived):
+                errors.append(
+                    f"segment {si} decision {i}: recorded but NOT "
+                    f"derivable from telemetry: {_fmt(recorded[i])}")
+                continue
+            if i >= len(recorded):
+                errors.append(
+                    f"segment {si} decision {i}: derived from telemetry "
+                    f"but missing from the stream: {_fmt(derived[i])}")
+                continue
+            if _decision_key(derived[i]) != _decision_key(recorded[i]):
+                errors.append(
+                    f"segment {si} decision {i} diverges:\n"
+                    f"    recorded: {_fmt(recorded[i])}\n"
+                    f"    derived:  {_fmt(derived[i])}")
+    return checked
+
+
+def check_supervisor_records(records: List[Dict[str, Any]],
+                             errors: List[str]) -> int:
+    """Verify restart attempt numbering and recomputed seeded backoff."""
+    header = next((r for r in records
+                   if r.get("event") == "run_header"), None)
+    config = (header or {}).get("config")
+    sup = [r for r in records if r.get("event") == "control"
+           and r.get("source") == "supervisor"]
+    restarts = [r for r in sup if r.get("intervention") == "restart"]
+    for i, rec in enumerate(restarts):
+        if rec.get("attempt") != i + 1:
+            errors.append(
+                f"supervisor restart {i}: attempt={rec.get('attempt')!r}"
+                f" but restarts must count up from 1 (expected {i + 1})")
+    if isinstance(config, dict):
+        # ladder never overrides restart_backoff/seed, so the FIRST
+        # header's values govern every segment's backoff
+        from federated_pytorch_test_tpu.control.supervisor import (
+            restart_backoff_seconds)
+        base = config.get("restart_backoff")
+        seed = config.get("seed")
+        if isinstance(base, (int, float)) and isinstance(seed, int):
+            for rec in restarts:
+                attempt = rec.get("attempt")
+                got = rec.get("backoff_seconds")
+                if not isinstance(attempt, int):
+                    continue
+                want = restart_backoff_seconds(float(base), seed, attempt)
+                if got != want:
+                    errors.append(
+                        f"supervisor restart attempt {attempt}: recorded "
+                        f"backoff_seconds={got!r} but the seeded formula "
+                        f"gives {want!r} (base={base}, seed={seed})")
+    return len(sup)
+
+
+def replay(records: List[Dict[str, Any]]) -> Tuple[List[str], Dict[str, int]]:
+    """Full replay check; returns (errors, stats)."""
+    errors: List[str] = []
+    segments = segment_stream(records)
+    n_policy = check_policy_records(segments, errors)
+    n_sup = check_supervisor_records(records, errors)
+    return errors, {"segments": len(segments), "policy_records": n_policy,
+                    "supervisor_records": n_sup}
+
+
+def selftest() -> str:
+    """Synthesize a stream through the REAL recorder+controller pipeline,
+    then assert replay reproduces it (exit 0) and detects tampering
+    (exit 1) — chained into the tier-1 ``report --selftest`` flow."""
+    import json
+    import os
+    import tempfile
+
+    from federated_pytorch_test_tpu.control.policy import (
+        controller_from_config)
+    from federated_pytorch_test_tpu.obs.recorder import make_recorder
+    from federated_pytorch_test_tpu.obs.report import read_records
+
+    config = {"K": 2, "control": "observe", "control_policy": "eager",
+              "compress": "none", "max_staleness": 4, "trim_frac": 0.1,
+              "default_batch": 128, "robust_agg": "none",
+              "fused_collective": False, "async_rounds": False,
+              "health_window": 8, "seed": 0, "restart_backoff": 1.0}
+
+    def synth(d: str, rounds) -> str:
+        rec = make_recorder("jsonl", d, run_name="ctl-selftest",
+                            engine="selftest", algorithm="fedavg")
+        controller_from_config(config, recorder=rec)
+        rec.open(config=config)
+        for i, comm in enumerate(rounds):
+            rec.round({"round_index": i, "nloop": 0, "block": 0,
+                       "nadmm": i, "N": 10, "loss": 1.0, "rho": 1.0,
+                       "round_seconds": 1.0, "comm_seconds": comm,
+                       "images": 256})
+        rec.close()
+        return os.path.join(d, "ctl-selftest.jsonl")
+
+    with tempfile.TemporaryDirectory() as d:
+        # comm fraction 0.8 for 2 rounds trips the eager preset's
+        # escalation streak — exactly one decision fires
+        path = synth(d, [0.8, 0.8, 0.1, 0.1])
+        records = read_records(path)
+        ctl_recs = [r for r in records if r.get("event") == "control"]
+        assert len(ctl_recs) == 1, ctl_recs
+        assert ctl_recs[0]["intervention"] == "escalate_compression", \
+            ctl_recs
+        assert ctl_recs[0]["to_value"] == "q8", ctl_recs
+        assert "time_unix" not in ctl_recs[0], \
+            "control records must not carry wall-clock time"
+        errors, stats = replay(records)
+        assert not errors, errors
+        assert stats["policy_records"] == 1, stats
+
+        # healthy stream: zero decisions, replay still passes
+        d2 = os.path.join(d, "healthy")
+        os.makedirs(d2, exist_ok=True)
+        errors2, _ = replay(read_records(synth(d2, [0.1, 0.1, 0.1])))
+        assert not errors2, errors2
+
+        # tampering: flip the decision's to_value -> divergence
+        tampered = []
+        for r in records:
+            r = dict(r)
+            if r.get("event") == "control":
+                r["to_value"] = "topk"
+            tampered.append(r)
+        errors3, _ = replay(tampered)
+        assert errors3 and "diverges" in errors3[0], errors3
+
+        # tampering: drop the record entirely -> "missing from stream"
+        dropped = [r for r in records if r.get("event") != "control"]
+        errors4, _ = replay(dropped)
+        assert errors4 and "missing from the stream" in errors4[0], \
+            errors4
+
+        # supervisor backoff verification catches a forged value
+        from federated_pytorch_test_tpu.control.supervisor import (
+            restart_backoff_seconds)
+        from federated_pytorch_test_tpu.obs.schema import SCHEMA_VERSION
+        good = restart_backoff_seconds(1.0, 0, 1)
+        sup = {"event": "control", "schema": SCHEMA_VERSION,
+               "run_id": "x", "round_index": 3, "source": "supervisor",
+               "mode": "act", "applied": True, "intervention": "restart",
+               "param": "run", "attempt": 1, "backoff_seconds": good,
+               "reason": "selftest"}
+        errors5, _ = replay(records + [sup])
+        assert not errors5, errors5
+        errors6, _ = replay(records
+                            + [dict(sup, backoff_seconds=good + 1.0)])
+        assert errors6 and "seeded formula" in errors6[0], errors6
+        json.dumps(stats)  # stats stay JSON-representable
+    return "control replay selftest: OK (decisions reproduce; tampering detected)"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m federated_pytorch_test_tpu.control.replay",
+        description="Re-derive control decisions from a recorded obs "
+                    "JSONL and diff against the recorded control "
+                    "records (see README 'Control plane')")
+    p.add_argument("path", nargs="?", help="run JSONL file")
+    p.add_argument("--selftest", action="store_true",
+                   help="run the built-in replay selftest and exit")
+    args = p.parse_args(argv)
+    if args.selftest:
+        print(selftest())
+        return 0
+    if not args.path:
+        p.error("a run JSONL path is required (or --selftest)")
+    from federated_pytorch_test_tpu.obs.report import read_records
+    from federated_pytorch_test_tpu.obs.schema import SchemaError
+    try:
+        records = read_records(args.path)
+    except (OSError, SchemaError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    errors, stats = replay(records)
+    if errors:
+        print(f"REPLAY DIVERGED ({len(errors)} problem(s)) over "
+              f"{stats['segments']} segment(s):")
+        for e in errors:
+            print(f"  - {e}")
+        return 1
+    print(f"replay OK: {stats['policy_records']} policy decision(s) and "
+          f"{stats['supervisor_records']} supervisor record(s) reproduce "
+          f"across {stats['segments']} segment(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -67,6 +67,25 @@ def build_parser(defaults: FederatedConfig, prog: str) -> argparse.ArgumentParse
                      "emits alert records, abort raises RunHealthAbort, "
                      "checkpoint-abort saves+verifies a final checkpoint "
                      "first (default: warn)")
+        elif f.name == "control":
+            from federated_pytorch_test_tpu.control.policy import (
+                CONTROL_MODES,
+            )
+            p.add_argument(
+                arg, choices=CONTROL_MODES, default=default,
+                help="closed-loop control plane (control/): observe "
+                     "records deterministic intervention decisions, act "
+                     "applies them; replay with python -m "
+                     "federated_pytorch_test_tpu.control.replay "
+                     "(default: off — bit-identical to no controller)")
+        elif f.name == "control_policy":
+            from federated_pytorch_test_tpu.control.policy import (
+                CONTROL_POLICIES,
+            )
+            p.add_argument(
+                arg, choices=CONTROL_POLICIES, default=default,
+                help="hysteresis preset for --control decisions "
+                     "(control/policy.py; default: default)")
         elif f.name == "compile_cache_dir":
             p.add_argument(
                 arg, type=str, default=default, metavar="DIR",
@@ -253,10 +272,34 @@ def run_classifier_driver(prog: str, defaults: FederatedConfig,
     if independent:
         state, history = trainer.run_independent(state)
     else:
+        supervised = cfg.max_restarts > 0
+        # supervision is resume-from-checkpoint: a restart budget forces
+        # the mid-run checkpoint on even without --midrun-checkpoint
         ck = (checkpoint_path(cfg, prog + "_midrun")
-              if cfg.midrun_checkpoint else None)
-        state, history = trainer.run(state, checkpoint_path=ck,
-                                     resume=cfg.load_model and ck is not None)
+              if (cfg.midrun_checkpoint or supervised) else None)
+        if supervised:
+            from federated_pytorch_test_tpu.control.supervisor import (
+                supervise_classifier,
+            )
+
+            def build_trainer(c, attempt):
+                nonlocal trainer
+                if attempt > 1:
+                    # the failed attempt's trainer is closed (staging
+                    # pool shut down); rebuild on the (possibly
+                    # ladder-degraded) config
+                    trainer = make_trainer(c, algorithm,
+                                           args.n_train, args.n_test)
+                    trainer.obs_run_name = prog
+                return trainer
+
+            state, history = supervise_classifier(
+                build_trainer, cfg, ck, state=state,
+                resume=cfg.load_model)
+        else:
+            state, history = trainer.run(
+                state, checkpoint_path=ck,
+                resume=cfg.load_model and ck is not None)
     print("Finished Training")
     print_obs_artifact(trainer)
     finish(trainer, state, prog, history)

@@ -111,6 +111,19 @@ def load_source(path: str) -> Dict[str, Any]:
                 src["metrics"][k] = v
         if s.get("status") != "completed":
             src["notes"].append(f"status={s.get('status')}")
+        # control-plane records (schema v8): a supervised run that
+        # restarted or had interventions fire is flagged, never gated —
+        # its wall-clock numbers include recovery work and a changed
+        # config, so a "regression" verdict would be comparing different
+        # experiments
+        if s.get("restarts"):
+            src["notes"].append(
+                f"{s['restarts']} supervised restart(s); wall-clock "
+                "metrics include recovery")
+        elif s.get("controls"):
+            src["notes"].append(
+                f"{s['controls']} control intervention(s) fired "
+                "mid-run")
         return src
     try:
         with open(path) as f:

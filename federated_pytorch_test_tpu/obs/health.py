@@ -18,6 +18,11 @@ is caught even when no JSONL sink is configured.  Rules:
   arrived, for ``streak`` rounds
 - ``zero_progress``       — no client contributed (``n_active``/``n_ok``
   zero) for ``streak`` rounds
+- ``nonfinite_residual``  — (opt-in, ``--health-residual``) NaN/inf ADMM
+  primal/dual residual for ``streak`` rounds.  Residuals poison the
+  consensus fold the same round they appear, one to two rounds BEFORE
+  the (staged) loss goes non-finite — tripping here is what keeps a
+  clean checkpoint slot alive for the restart supervisor to resume from
 
 Each trip emits a structured ``alert`` record into the SAME stream the
 round records use.  What happens next is ``health_action``:
@@ -66,7 +71,8 @@ class HealthMonitor:
     def __init__(self, *, action: str = "warn", streak: int = 3,
                  window: int = 8, loss_mult: float = 10.0,
                  tput_frac: float = 0.25,
-                 n_clients: Optional[int] = None):
+                 n_clients: Optional[int] = None,
+                 residual_check: bool = False):
         if action not in HEALTH_ACTIONS:
             raise ValueError(f"health action {action!r} not in "
                              f"{HEALTH_ACTIONS}")
@@ -78,6 +84,7 @@ class HealthMonitor:
         self.loss_mult = float(loss_mult)
         self.tput_frac = float(tput_frac)
         self.n_clients = n_clients
+        self.residual_check = bool(residual_check)
         self.recorder = None          # set by RunRecorder.attach_health
         self.tripped: Optional[Dict[str, Any]] = None  # first fatal alert
         self.alerts: List[Dict[str, Any]] = []
@@ -225,6 +232,26 @@ class HealthMonitor:
                            observed=rejected, threshold=float(max(1, base)),
                            streak=n)
 
+        # nonfinite_residual (opt-in): the consensus fold is already
+        # poisoned the round a residual goes NaN — earlier than the
+        # staged loss can show it, so the previous checkpoint slot is
+        # still clean when the abort fires.
+        if self.residual_check:
+            primal = rec.get("primal_residual")
+            dual = rec.get("dual_residual")
+            have = (isinstance(primal, float) or isinstance(dual, float))
+            bad = ((isinstance(primal, float) and not math.isfinite(primal))
+                   or (isinstance(dual, float) and not math.isfinite(dual)))
+            if have:
+                n = self._bump("nonfinite_residual", bad)
+                if n >= self.streak:
+                    self._fire(rec, "nonfinite_residual",
+                               f"ADMM residual non-finite for {n} "
+                               f"consecutive rounds",
+                               observed=(dual if isinstance(dual, float)
+                                         else -1.0),
+                               threshold=float(self.streak), streak=n)
+
         # zero_progress: no client contributed
         n_active = rec.get("n_active")
         n_ok = rec.get("n_ok")
@@ -257,6 +284,7 @@ def monitor_from_config(cfg, recorder=None) -> Optional[HealthMonitor]:
         loss_mult=getattr(cfg, "health_loss_mult", 10.0),
         tput_frac=getattr(cfg, "health_tput_frac", 0.25),
         n_clients=getattr(cfg, "K", None),
+        residual_check=getattr(cfg, "health_residual", False),
     )
     if recorder is not None:
         recorder.attach_health(mon)

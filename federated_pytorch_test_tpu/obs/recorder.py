@@ -116,6 +116,11 @@ class RunRecorder:
         self.health = None
         self._span_extent: Optional[List[float]] = None
         self._alerts = 0
+        # closed-loop control plane (schema v8): the attached Controller
+        # (control/policy.py; sink-independent like the watchdog) and the
+        # intervention tally surfaced on the summary
+        self.control = None
+        self._controls = 0
         # device-cost ledger totals (schema v6): compile events emitted
         # through compile_event(), and the device-memory high-watermark
         # tracked across round records (device_memory_stats is
@@ -149,6 +154,19 @@ class RunRecorder:
         self.health = monitor
         if monitor is not None:
             monitor.recorder = self
+
+    def attach_control(self, controller) -> None:
+        """Tap a :class:`~..control.policy.Controller` into the round
+        stream.  Like the watchdog it is in-process and sink-independent.
+        Feed order matters for replay: the controller observes each
+        round record BEFORE the health monitor runs on it (the monitor
+        may emit alert records, which the controller also observes), so
+        the in-process observation order equals the JSONL file order —
+        round N, then round N's alerts — and ``control.replay`` can
+        re-derive decisions by feeding records in file order."""
+        self.control = controller
+        if controller is not None:
+            controller.recorder = self
 
     def _grow_extent(self, t_start, t_end) -> None:
         if not (isinstance(t_start, (int, float))
@@ -211,7 +229,8 @@ class RunRecorder:
         close — so pre-v5 consumers and the lifecycle tests see an
         unchanged stream.
         """
-        if not self.enabled and self.health is None:
+        if (not self.enabled and self.health is None
+                and self.control is None):
             return None
         idx = fields.get("round_index")
         if not isinstance(idx, int):
@@ -267,6 +286,11 @@ class RunRecorder:
             out = self._emit(rec)
         else:
             out = rec  # watchdog-only mode: observe, never write
+        if self.control is not None:
+            # BEFORE health: the monitor may emit alert records during
+            # observe(), and the controller must see round N before
+            # round N's alerts (file order — see attach_control)
+            self.control.observe(rec)
         if self.health is not None:
             self.health.observe(rec)
         return out
@@ -308,10 +332,34 @@ class RunRecorder:
         is attached (the watchdog still ran); written only when one is.
         """
         self._alerts += 1
+        if self.control is not None:
+            # the alert is policy input too (the HealthMonitor tap);
+            # fed whether or not a sink writes it — replay sees it in
+            # the stream at exactly this position.  json_safe first so
+            # the controller sees bit-identical values in-process and
+            # from a parsed file.
+            self.control.observe(json_safe(dict(fields, event="alert")))
         if not self.enabled:
             return None
         rec = {"event": "alert", "schema": SCHEMA_VERSION,
                "run_id": self.run_id, "time_unix": time.time()}
+        rec.update(json_safe(fields))
+        return self._emit(rec)
+
+    def control_event(self, fields: Dict[str, Any]) -> Optional[dict]:
+        """Emit one ``control`` record (schema v8; control/).
+
+        Counted toward the summary's ``interventions_total`` even when
+        no sink is attached (the decision was still made); written only
+        when one is.  Deliberately NO ``time_unix``: a control record
+        is a pure function of recorded telemetry + round index, the
+        determinism contract ``control.replay`` checks.
+        """
+        self._controls += 1
+        if not self.enabled:
+            return None
+        rec = {"event": "control", "schema": SCHEMA_VERSION,
+               "run_id": self.run_id}
         rec.update(json_safe(fields))
         return self._emit(rec)
 
@@ -397,6 +445,8 @@ class RunRecorder:
             rec["loss_final"] = self._loss_final
         if self._alerts or self.health is not None:
             rec["alerts_total"] = self._alerts
+        if self._controls or self.control is not None:
+            rec["interventions_total"] = self._controls
         if self._compile_events:
             rec["compile_events_total"] = self._compile_events
             rec["compile_seconds_total"] = self._compile_seconds

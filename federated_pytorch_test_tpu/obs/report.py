@@ -93,7 +93,8 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     }
     for key in ("round_seconds", "stage_seconds", "comm_seconds",
                 "bytes_on_wire", "bytes_dense", "images", "guard_trips",
-                "fault_dropped", "fault_straggled", "fault_corrupted"):
+                "fault_dropped", "fault_straggled", "fault_corrupted",
+                "bytes_fused", "overlap_seconds"):
         out[key + "_total"] = tot(key)
     losses = [r["loss"] for r in rounds
               if isinstance(r.get("loss"), (int, float))]
@@ -136,6 +137,13 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     alerts = [r for r in records if r.get("event") == "alert"]
     out["alerts"] = len(alerts)
     out["alert_rules"] = sorted({a.get("rule", "?") for a in alerts})
+    # control-plane interventions (schema v8)
+    controls = [r for r in records if r.get("event") == "control"]
+    out["controls"] = len(controls)
+    out["control_interventions"] = sorted(
+        {c.get("intervention", "?") for c in controls})
+    out["restarts"] = sum(1 for c in controls
+                          if c.get("intervention") == "restart")
     # device-cost ledger (schema v6): compile totals recomputed from the
     # round records; the memory watermark is the max across the rounds'
     # instantaneous stats (matches the recorder's summary field)
@@ -219,9 +227,19 @@ def format_report(s: Dict[str, Any]) -> str:
         if s.get("staleness_hist_total"):
             msg += f", staleness_hist={s['staleness_hist_total']}"
         row("async", msg)
+    if s.get("bytes_fused_total"):
+        row("bytes fused", _fmt_bytes(s["bytes_fused_total"])
+            + "  (stayed packed across the reduction)")
+    if s.get("overlap_seconds_total"):
+        row("comm overlap", f"{s['overlap_seconds_total']:.2f} s hidden "
+            "behind staging")
     if s.get("alerts"):
         row("health alerts",
             f"{s['alerts']} alert(s): {', '.join(s.get('alert_rules') or [])}")
+    if s.get("controls"):
+        row("control plane",
+            f"{s['controls']} record(s), {s.get('restarts', 0)} restart(s)"
+            f": {', '.join(s.get('control_interventions') or [])}")
     if s.get("compile_events") or s.get("compile_seconds_total"):
         msg = f"{s.get('compile_events', 0)} event(s)"
         if s.get("compile_seconds_total") is not None:
@@ -242,9 +260,10 @@ def format_report(s: Dict[str, Any]) -> str:
 
 def selftest() -> str:
     """Recorder → JSONL → parse → validate → summarise round-trip, plus
-    the trace-exporter, watchdog, compare, and cost-profile selftests
-    (tier-1 runs this, so the whole live-health + device-cost layer is
-    exercised without a prior training run)."""
+    the trace-exporter, watchdog, compare, cost-profile, and
+    control-replay selftests (tier-1 runs this, so the whole
+    live-health + device-cost + control-plane layer is exercised
+    without a prior training run)."""
     import os
     import tempfile
 
@@ -260,6 +279,7 @@ def selftest() -> str:
                        "rho": 1.0, "round_seconds": 0.5,
                        "stage_seconds": 0.01, "comm_seconds": 0.1,
                        "bytes_on_wire": 100, "bytes_dense": 400,
+                       "bytes_fused": 50, "overlap_seconds": 0.02,
                        "images": 256, "guard_trips": 1 if i == 2 else 0,
                        "quarantined": 0,
                        "async_mode": True, "max_staleness": 2,
@@ -281,22 +301,29 @@ def selftest() -> str:
         assert s["buffer_depth_peak"] == 2, s
         assert s["admission_rejected_total"] == 3, s
         assert s["staleness_hist_total"] == [6, 0, 0], s
+        assert s["bytes_fused_total"] == 150, s
+        assert abs(s["overlap_seconds_total"] - 0.06) < 1e-9, s
         table = format_report(s)
         assert "async" in table, table
+        assert "bytes fused" in table, table
+        assert "comm overlap" in table, table
     assert record_ips({"images": 256, "round_seconds": 0}) == float("inf")
     assert record_ips({"images": 0, "round_seconds": 0}) == 0.0
 
+    from federated_pytorch_test_tpu.control import replay as control_replay
     from federated_pytorch_test_tpu.obs import compare, health, profile, trace
 
     trace.selftest()
     health.selftest()
     compare.selftest()
     profile.selftest()
+    control_replay.selftest()
     return (table
             + "\nobs trace selftest: OK (Chrome trace valid)"
             + "\nobs health selftest: OK (NaN streak alerted)"
             + "\nobs compare selftest: OK (regression gate works)"
             + "\nobs profile selftest: OK (cost attribution reconstructs)"
+            + "\ncontrol replay selftest: OK (decisions reproduce)"
             + "\nobs report selftest: OK")
 
 

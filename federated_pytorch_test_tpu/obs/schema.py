@@ -1,6 +1,6 @@
 """Versioned record schema for run telemetry.
 
-One run = one JSONL stream of six event kinds:
+One run = one JSONL stream of seven event kinds:
 
 - ``run_header``  — emitted once when a run (or resumed segment) opens:
   config snapshot, mesh shape, jax/backend versions, git rev.
@@ -20,6 +20,12 @@ One run = one JSONL stream of six event kinds:
   ``obs/costs.py``): site label, compile wall-seconds, trace count,
   AOT cost-model / memory-analysis numbers where available, and
   persistent-compile-cache hit/miss attribution.
+- ``control``     — one per control-plane decision (schema v8;
+  ``control/``): a typed intervention from the deterministic policy
+  engine or the restart supervisor — which knob, from/to values,
+  scope, whether it was applied, and the telemetry that justified it.
+  Pure function of the recorded stream (no wall clock): replay with
+  ``python -m federated_pytorch_test_tpu.control.replay``.
 
 The schema unifies what ``engine.py``, ``cpc_engine.py`` and
 ``vae_engine.py`` used to build as ad-hoc dicts; every record carries
@@ -76,10 +82,26 @@ from typing import Any, Dict
 # round's first epoch while the comm dispatch was in flight; present only
 # when --overlap-staging is on, 0.0 when there was nothing left to
 # prestage).
-# v1..v6 records remain valid: validate_record accepts ver <= SCHEMA_VERSION.
-SCHEMA_VERSION = 7
+# v8 (additive): the closed-loop control plane (control/) — a new
+# `control` record kind, one per policy decision or supervisor restart
+# action.  `source` says who decided ("policy" = the deterministic
+# in-run rule engine, "supervisor" = the restart wrapper between run
+# segments); `intervention`/`param`/`from_value`/`to_value`/`scope`
+# describe the typed knob change; `mode` ("observe"|"act") and
+# `applied` record whether the engine actually took it; `reason`
+# carries the rule text; `observed`/`threshold`/`streak` reuse the
+# alert-field semantics for the triggering telemetry.  Supervisor
+# records add `attempt` (1-based restart count), `backoff_seconds`
+# (seeded deterministic backoff) and `ladder_stage`.  Control records
+# deliberately carry NO time_unix: every field is a pure function of
+# recorded telemetry + round index, so control.replay can re-derive
+# the decision sequence bit-exactly from the stream.  The summary
+# gains `interventions_total`.
+# v1..v7 records remain valid: validate_record accepts ver <= SCHEMA_VERSION.
+SCHEMA_VERSION = 8
 
-EVENTS = ("run_header", "round", "summary", "span", "alert", "compile")
+EVENTS = ("run_header", "round", "summary", "span", "alert", "compile",
+          "control")
 
 
 class SchemaError(ValueError):
@@ -120,7 +142,8 @@ FIELDS: Dict[str, Any] = {
     "pid":          (("run_header",), _INT),
     # round coordinates (spans and alerts are keyed to the same index the
     # XProf round_trace annotations use, so all three timelines correlate)
-    "round_index":  (("round", "span", "alert", "compile"), _INT),
+    "round_index":  (("round", "span", "alert", "compile", "control"),
+                     _INT),
     "nloop":        (("round",), _INT),
     "block":        (("round",), _INT),
     "nadmm":        (("round",), _INT),
@@ -208,10 +231,25 @@ FIELDS: Dict[str, Any] = {
     "rule":         (("alert",), _STR),
     "severity":     (("alert",), _STR),       # warn|fatal
     "message":      (("alert",), _STR),
-    "observed":     (("alert",), _NUM),       # value that tripped the rule
-    "threshold":    (("alert",), _NUM),
-    "streak":       (("alert",), _INT),       # consecutive bad rounds
+    "observed":     (("alert", "control"), _NUM),  # triggering value
+    "threshold":    (("alert", "control"), _NUM),
+    "streak":       (("alert", "control"), _INT),  # consecutive bad rounds
     "action":       (("alert",), _STR),       # health_action at trip time
+    # closed-loop control plane (schema v8; control/).  NO time_unix on
+    # purpose: a control record is a pure function of recorded telemetry
+    # and the round index, so control.replay reproduces it bit-exactly.
+    "source":       (("control",), _STR),     # policy|supervisor
+    "intervention": (("control",), _STR),     # typed action name
+    "param":        (("control",), _STR),     # cfg knob it targets
+    "from_value":   (("control",), _ANY),
+    "to_value":     (("control",), _ANY),
+    "reason":       (("control",), _STR),
+    "mode":         (("control",), _STR),     # observe|act
+    "applied":      (("control",), _BOOL),    # engine took the action
+    "scope":        (("control",), _STR),     # round|block|restart
+    "attempt":      (("control",), _INT),     # supervisor: restart count
+    "backoff_seconds": (("control",), _NUM),  # supervisor: seeded backoff
+    "ladder_stage": (("control",), _INT),     # supervisor: degradation rung
     # summary totals / rates
     "status":       (("summary",), _STR),
     "rounds":       (("summary",), _INT),
@@ -234,6 +272,7 @@ FIELDS: Dict[str, Any] = {
     "comm_overhead_frac": (("summary",), _NUM),
     "compression_savings_frac": (("summary",), _NUM),
     "alerts_total": (("summary",), _INT),
+    "interventions_total": (("summary",), _INT),
     # device-cost + memory-watermark summary (schema v6)
     "compile_events_total": (("summary",), _INT),
     "compile_seconds_total": (("summary",), _NUM),
@@ -252,6 +291,8 @@ REQUIRED = {
              "t_end"),
     "alert": ("event", "schema", "run_id", "rule", "round_index"),
     "compile": ("event", "schema", "run_id", "site", "compile_seconds"),
+    "control": ("event", "schema", "run_id", "round_index", "source",
+                "intervention"),
 }
 
 

@@ -5,7 +5,12 @@ A sink receives every schema-validated record (``run_header`` /
 
 - :class:`JsonlSink`  — one JSON object per line, append mode (a
   resumed run extends the same file), flushed per record so a killed
-  run keeps everything up to its last completed round.
+  run keeps everything up to its last completed round.  A transient
+  ``OSError`` on the per-record write is retried with bounded backoff;
+  a persistently failing filesystem degrades the sink to an in-memory
+  overflow buffer (one structured warning, the run keeps going —
+  telemetry must never kill training).  ``close()`` makes one last
+  attempt to land the overflow on disk.
 - :class:`CsvSink`    — ``round`` records only; columns fixed by the
   first round record (later extra keys are dropped, missing keys blank)
   so the file stays loadable by anything that reads CSV.
@@ -23,6 +28,8 @@ from __future__ import annotations
 
 import json
 import os
+import sys
+import time
 from typing import IO, List, Optional, Tuple
 
 SINK_CHOICES = ("auto", "none", "jsonl", "csv", "stdout", "memory")
@@ -39,20 +46,83 @@ class Sink:
 
 
 class JsonlSink(Sink):
-    def __init__(self, path: str):
-        self.path = path
-        self._f: Optional[IO[str]] = None
+    #: per-record write attempts before the sink degrades; backoff is
+    #: ``retry_backoff * 2**i`` between attempts (tiny — this guards
+    #: against transient EAGAIN/ENOSPC blips, not outages)
+    RETRIES = 3
+    #: overflow cap: a degraded long run must not eat the heap; the
+    #: newest records win because the tail is what post-mortems read
+    OVERFLOW_CAP = 10_000
 
-    def emit(self, record: dict) -> None:
+    def __init__(self, path: str, retry_backoff: float = 0.05,
+                 sleep=time.sleep):
+        self.path = path
+        self.retry_backoff = float(retry_backoff)
+        self._sleep = sleep
+        self._f: Optional[IO[str]] = None
+        self.degraded = False
+        self.overflow: List[dict] = []
+        self.dropped = 0
+
+    def _write_line(self, line: str) -> None:
         if self._f is None:
             os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
             self._f = open(self.path, "a")
-        self._f.write(json.dumps(record) + "\n")
+        self._f.write(line)
         self._f.flush()
 
+    def _buffer(self, record: dict) -> None:
+        if len(self.overflow) >= self.OVERFLOW_CAP:
+            self.overflow.pop(0)
+            self.dropped += 1
+        self.overflow.append(record)
+
+    def emit(self, record: dict) -> None:
+        if self.degraded:
+            self._buffer(record)
+            return
+        line = json.dumps(record) + "\n"
+        last: Optional[OSError] = None
+        for i in range(self.RETRIES):
+            try:
+                self._write_line(line)
+                return
+            except OSError as e:
+                last = e
+                # a failed write leaves the handle in an unknown state;
+                # drop it so the retry reopens (append mode, no loss)
+                try:
+                    if self._f is not None:
+                        self._f.close()
+                except OSError:
+                    pass
+                self._f = None
+                if i + 1 < self.RETRIES and self.retry_backoff > 0:
+                    self._sleep(self.retry_backoff * (2.0 ** i))
+        # persistent failure: degrade to the in-memory overflow buffer
+        # with ONE structured warning — telemetry never kills the run
+        self.degraded = True
+        self._buffer(record)
+        print(json.dumps({"event": "sink_degraded", "sink": "jsonl",
+                          "path": self.path, "retries": self.RETRIES,
+                          "error": str(last)}),
+              file=sys.stderr, flush=True)
+
     def close(self) -> None:
+        if self.degraded and self.overflow:
+            # one last attempt: the filesystem may have come back
+            try:
+                self._write_line("".join(json.dumps(r) + "\n"
+                                         for r in self.overflow))
+                self.overflow = []
+                self.degraded = False
+            except OSError:
+                pass
         if self._f is not None:
-            self._f.close()
+            try:
+                self._f.close()
+            except OSError:
+                pass
             self._f = None
 
 

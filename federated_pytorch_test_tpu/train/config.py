@@ -271,6 +271,31 @@ class FederatedConfig:
     health_window: int = 8        # EMA warm-up / rolling-median window
     health_loss_mult: float = 10.0  # divergence envelope multiplier
     health_tput_frac: float = 0.25  # collapse floor vs rolling median
+    # Opt-in early-warning rule: trip on NaN/inf ADMM residuals, which
+    # poison the consensus fold one to two rounds before the (staged)
+    # loss shows it.  Tripping on the poison round itself is what keeps
+    # a clean checkpoint slot alive for the restart supervisor.
+    health_residual: bool = False
+
+    # closed-loop control plane (control/): deterministic policy engine
+    # over the obs stream + restart supervisor.  control picks the mode:
+    # "off" (no controller at all — bit-identical to the uncontrolled
+    # path, the default), "observe" (decisions recorded as `control`
+    # records, nothing applied), "act" (round/block-scope decisions
+    # applied live; checkpoint-then-restart raised to the supervisor).
+    # control_policy selects the hysteresis preset (policy.CONTROL_-
+    # POLICIES).  Every decision is a pure function of recorded
+    # telemetry + round index — replayable bit-exactly via
+    # `python -m federated_pytorch_test_tpu.control.replay` (PARITY.md).
+    control: str = "off"
+    control_policy: str = "default"
+    # restart supervisor (control/supervisor.py): on RunHealthAbort /
+    # ControlRestart, resume from the last verified checkpoint at most
+    # max_restarts times with seeded exponential backoff (base
+    # restart_backoff seconds), walking the degradation ladder from the
+    # second restart on.  0 = no supervision (default).
+    max_restarts: int = 0
+    restart_backoff: float = 1.0
 
     # runtime sanitizers (analysis/sanitize.py) — both default-off, and
     # with both off the engine builds the literal uninstrumented

@@ -694,12 +694,30 @@ class CPCTrainer:
                                                 and checkpoint_path
                                                 is not None):
                                             # this round already saved; just
-                                            # drain the writer and verify
+                                            # drain the writer and verify.
+                                            # No slot at all (failed async
+                                            # save) degrades to a plain
+                                            # abort — the health alert must
+                                            # surface, not a secondary
+                                            # checkpoint error
+                                            from federated_pytorch_test_tpu\
+                                                .utils.checkpoint import (
+                                                NoUsableCheckpointError,
+                                            )
                                             self._flush_ckpt_writer()
-                                            slot = finalize_checkpoint(
-                                                checkpoint_path)
-                                            log("health: final checkpoint "
-                                                f"verified at {slot}")
+                                            try:
+                                                slot = finalize_checkpoint(
+                                                    checkpoint_path)
+                                            except NoUsableCheckpointError \
+                                                    as e:
+                                                log("WARNING: health: no "
+                                                    "usable checkpoint to "
+                                                    f"finalize ({e}); "
+                                                    "aborting without one")
+                                            else:
+                                                log("health: final "
+                                                    "checkpoint verified "
+                                                    f"at {slot}")
                                         raise RunHealthAbort(alert)
                                 log(f"dual (N={N},loop={nloop},model={mdl},"
                                     f"block={ci},avg={nadmm})="

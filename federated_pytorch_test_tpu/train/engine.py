@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 import time
 from typing import Any, Callable, Dict, List, NamedTuple, Optional
 
@@ -131,6 +132,11 @@ class BlockwiseFederatedTrainer:
         # prog name so the JSONL artifact is predictably named
         self.obs_recorder = None
         self.obs_run_name: Optional[str] = None
+        # control-plane cfg swaps (_apply_round_control/_apply_block_
+        # control) replace the frozen cfg dataclass while the epoch-stage
+        # worker reads fields off it; the lock makes the read-swap
+        # sequence atomic against that role
+        self._cfg_swap_lock = threading.Lock()
         # update compression (compress/): validated here so a bad flag
         # combination fails at construction, not mid-run inside jit
         self.compressor = make_compressor(
@@ -210,6 +216,21 @@ class BlockwiseFederatedTrainer:
         if cfg.guard_norm_mult <= 0:
             raise ValueError(
                 f"guard_norm_mult={cfg.guard_norm_mult} must be positive")
+        from federated_pytorch_test_tpu.control.policy import (
+            CONTROL_MODES, CONTROL_POLICIES)
+        if cfg.control not in CONTROL_MODES:
+            raise ValueError(
+                f"control={cfg.control!r} must be one of {CONTROL_MODES}")
+        if cfg.control_policy not in CONTROL_POLICIES:
+            raise ValueError(
+                f"control_policy={cfg.control_policy!r} must be one of "
+                f"{CONTROL_POLICIES}")
+        if cfg.max_restarts < 0:
+            raise ValueError(
+                f"max_restarts={cfg.max_restarts} must be >= 0")
+        if cfg.restart_backoff < 0:
+            raise ValueError(
+                f"restart_backoff={cfg.restart_backoff} must be >= 0")
         # host-side fault-tolerance state: per-client remaining quarantine
         # rounds and the per-block running guard norm scale (inf = not yet
         # calibrated; no norm bound until one clean round has been seen).
@@ -1545,6 +1566,25 @@ class BlockwiseFederatedTrainer:
         return state, blockvars, (int(meta["nloop"]), int(meta["ci"]),
                                   int(meta["nadmm"]), mid), history
 
+    def _check_restored_finite(self, restored) -> None:
+        """Reject a restored snapshot that carries NaN/inf params or
+        block consensus vars.  Used by the resume slot-walk: such a
+        slot is checksum-valid (the poison was faithfully saved) but
+        resuming it replays the failure, so the walk treats it like a
+        corrupt slot and falls back to the next-older generation."""
+        state, blockvars = restored[0], restored[1]
+        leaves = list(jax.tree_util.tree_leaves(state.params))
+        if blockvars is not None:
+            leaves += [blockvars[0], blockvars[1]]   # z, y: the fold targets
+        for leaf in leaves:
+            a = np.asarray(jax.device_get(leaf))
+            if a.dtype.kind == "V":                  # ml_dtypes bf16 et al.
+                a = a.astype(np.float32)
+            if a.dtype.kind == "f" and not np.all(np.isfinite(a)):
+                raise ValueError(
+                    "restored state carries non-finite values "
+                    "(poisoned checkpoint)")
+
     def _profile_ctx(self):
         """jax.profiler trace over the run when cfg.profile_dir is set
         (shared helper, utils/profiling.py)."""
@@ -1589,6 +1629,14 @@ class BlockwiseFederatedTrainer:
         # "warn" is bit-identical training math either way
         from federated_pytorch_test_tpu.obs.health import monitor_from_config
         monitor_from_config(cfg, recorder=rec)
+        # closed-loop controller (control/policy.py): attached AFTER the
+        # monitor so the recorder can feed it round N before round N's
+        # alerts (file order — the replay contract).  None when
+        # cfg.control == "off": nothing attached, the stream and the
+        # training math are bit-identical to the uncontrolled path.
+        from federated_pytorch_test_tpu.control.policy import (
+            controller_from_config)
+        controller_from_config(cfg, recorder=rec)
         self.obs_recorder = rec
         return rec
 
@@ -1664,9 +1712,95 @@ class BlockwiseFederatedTrainer:
                                     f"{run_name}_health_abort")
                 self._save_midrun(path, state, blockvars, nxt, history)
             self._flush_ckpt_writer()
-            slot = finalize_checkpoint(path)
-            log(f"health: final checkpoint verified at {slot}")
+            from federated_pytorch_test_tpu.utils.checkpoint import (
+                NoUsableCheckpointError,
+            )
+            try:
+                slot = finalize_checkpoint(path)
+            except NoUsableCheckpointError as e:
+                # no slot ever landed (e.g. the async writer's save
+                # failed): degrade to a plain abort — the health alert
+                # must surface, not a secondary checkpoint error
+                log(f"WARNING: health: no usable checkpoint to finalize "
+                    f"({e}); aborting without one")
+            else:
+                log(f"health: final checkpoint verified at {slot}")
         raise RunHealthAbort(alert)
+
+    def _apply_round_control(self, obs, checkpoint_path, log=print):
+        """Apply act-mode round-scope decisions at the round boundary.
+
+        ``max_staleness`` is read from ``self.cfg`` on the host every
+        round (``_round_activity_async``), so swapping the config
+        dataclass applies it live — no recompile, no device traffic.
+        A ``checkpoint_restart`` decision flushes + verifies the newest
+        checkpoint slot and raises :class:`ControlRestart` for the
+        restart supervisor.
+        """
+        import dataclasses as _dc
+
+        ctl = obs.control
+        for d in ctl.take_round():
+            if d.param == "max_staleness":
+                with self._cfg_swap_lock:
+                    old = self.cfg.max_staleness
+                    self.cfg = _dc.replace(self.cfg,
+                                           max_staleness=int(d.to_value))
+                log(f"control: {d.intervention} max_staleness "
+                    f"{old} -> {self.cfg.max_staleness} ({d.reason})")
+        d = ctl.take_restart()
+        if d is not None:
+            from federated_pytorch_test_tpu.control.policy import (
+                ControlRestart,
+            )
+            from federated_pytorch_test_tpu.utils.checkpoint import (
+                finalize_checkpoint,
+            )
+            self._flush_ckpt_writer()
+            slot = finalize_checkpoint(checkpoint_path)
+            log(f"control: checkpoint-then-restart from verified {slot} "
+                f"({d.reason})")
+            raise ControlRestart(
+                d.fields(source="policy", mode="act", applied=True))
+
+    def _apply_block_control(self, obs, log=print):
+        """Apply act-mode block-scope decisions (compressor swap).
+
+        Runs at the block boundary BEFORE the block's fns/scratch/comp
+        state are built: the new compressor is baked into freshly
+        compiled round fns and gets fresh per-block compression state,
+        exactly as if the run had been constructed with it.  A swap
+        that would violate a construction rule (sparse wire under a
+        fused dual-state collective) is skipped, not forced.
+        """
+        import dataclasses as _dc
+
+        ctl = obs.control
+        for d in ctl.take_block():
+            if d.param != "compress":
+                continue
+            new = str(d.to_value)
+            if new == self.cfg.compress:
+                continue
+            comp = make_compressor(new, topk_frac=self.cfg.topk_frac,
+                                   quant_chunk=self.cfg.quant_chunk,
+                                   error_feedback=self.cfg.error_feedback)
+            if self._fused_coll and comp.name == "none":
+                log("control: skip compress -> none (fused_collective "
+                    "needs a packed wire format)")
+                continue
+            if (self._fused_coll and getattr(comp, "sparse", False)
+                    and self.algo.needs_dual):
+                log(f"control: skip compress -> {new} (sparse wire is "
+                    "unavailable under a fused dual-state collective)")
+                continue
+            old = self.cfg.compress
+            with self._cfg_swap_lock:
+                self.compressor = comp
+                self.cfg = _dc.replace(self.cfg, compress=new)
+            self._fn_cache.clear()
+            log(f"control: {d.intervention} compress {old} -> {new} at "
+                f"block boundary ({d.reason})")
 
     def __del__(self):
         try:
@@ -1726,8 +1860,17 @@ class BlockwiseFederatedTrainer:
         for slot in slots:
             try:
                 verify_checkpoint(slot)      # raises on checksum mismatch
-                state, r_blockvars, resume_at, history = \
-                    self._restore_midrun(slot)
+                restored = self._restore_midrun(slot)
+                # poison screen: a checkpoint whose params/block vars
+                # carry NaN/inf is checksum-valid but useless — resuming
+                # it replays the failure forever.  Fall back to the
+                # next-older slot instead (the rotation keeps three
+                # generations, so the last pre-poison save is usually
+                # still on disk).  This is the restore path ALL resumes
+                # share, so a supervised restart stays bitwise identical
+                # to a manual one.
+                self._check_restored_finite(restored)
+                state, r_blockvars, resume_at, history = restored
             except Exception as e:           # corrupt/truncated slot:
                 failures.append(f"{slot}: {e}")     # fall back, don't die
                 log(f"WARNING: checkpoint slot {slot} is unusable ({e}); "
@@ -1758,11 +1901,23 @@ class BlockwiseFederatedTrainer:
 
         obs = self._open_obs(resumed=resume_at is not None,
                              rounds_prior=len(history))
+        if obs.control is not None:
+            # checkpoint-then-restart is only on the table when there is
+            # a checkpoint to restart from; without one the decision is
+            # recorded (applied=False) and nothing is raised
+            obs.control.can_restart = checkpoint_path is not None
         obs_images = cfg.Nepoch * self._obs_epoch_images()
         for nloop in range(cfg.Nloop):
             for ci in range(self.L):
                 if resume_at is not None and (nloop, ci) < resume_at[:2]:
                     continue
+                if obs.control is not None:
+                    # block-scope interventions (compressor swap) land
+                    # HERE, before the round fns/scratch/comp-state for
+                    # this block are built — the compressor is baked
+                    # into the compiled fns, so mid-block application
+                    # is impossible by construction
+                    self._apply_block_control(obs, log)
                 train_epoch, comm_fns, init_opt = self._build_fns(ci)
                 N = self.block_size(ci)
                 # donated sparse accumulator (top-k only): zeroed [K, N]
@@ -2057,13 +2212,19 @@ class BlockwiseFederatedTrainer:
                                               history)
                             rec["ckpt_write_seconds"] = (
                                 time.perf_counter() - t_ckpt)
-                        if obs.enabled or obs.health is not None:
+                        if (obs.enabled or obs.health is not None
+                                or obs.control is not None):
                             extra = dict(rec, round_index=len(history) - 1,
                                          images=obs_images, t_start=t_round,
                                          **device_memory_stats())
                             if cfg.async_rounds:
                                 extra["async_mode"] = True
-                                extra["max_staleness"] = cfg.max_staleness
+                                # self.cfg, not the loop-local snapshot:
+                                # a round-scope control intervention may
+                                # have moved the cutoff, and the record
+                                # must carry the value actually in force
+                                extra["max_staleness"] = \
+                                    self.cfg.max_staleness
                             if algo.communicates:
                                 # dense comparator for the wire bytes: every
                                 # participant's f32 block payload
@@ -2103,6 +2264,13 @@ class BlockwiseFederatedTrainer:
                                     obs, checkpoint_path, state,
                                     (z, y, rho, x0, yhat0), nxt, history,
                                     log)
+                            if obs.control is not None:
+                                # round-scope interventions apply AFTER
+                                # the health check: a fatal trip owns
+                                # the exit, and the supervisor owns the
+                                # recovery
+                                self._apply_round_control(
+                                    obs, checkpoint_path, log)
                         blk = self.block_ids[ci]
                         msg = (f"block=[{blk[0]},{blk[1]}]({N},{float(rho):f}) "
                                f"round={nadmm}/{nloop} "

@@ -36,6 +36,16 @@ class CheckpointCorruptError(RuntimeError):
     mismatch / unreadable): truncated write, bit-rot, or tampering."""
 
 
+class NoUsableCheckpointError(FileNotFoundError):
+    """:func:`finalize_checkpoint` found NO slot on disk at all — there
+    is nothing to verify, so abort-with-checkpoint and supervised
+    restart both have no recovery point.  Subclasses
+    ``FileNotFoundError`` so pre-existing callers that caught the
+    untyped error keep working; the restart supervisor and the health
+    abort paths catch this type to degrade gracefully instead of dying
+    with a secondary exception that masks the original alert."""
+
+
 def _abspath(path: str) -> str:
     return os.path.abspath(os.path.expanduser(path))
 
@@ -146,11 +156,11 @@ def finalize_checkpoint(path: str) -> str:
     flushing any async writer, so the run dies with a proven-good
     checkpoint on disk.  Returns the verified slot path.  Raises
     :class:`CheckpointCorruptError` on checksum mismatch and
-    ``FileNotFoundError`` when no slot exists at all.
+    :class:`NoUsableCheckpointError` when no slot exists at all.
     """
     newest = newest_slot(path)
     if newest is None:
-        raise FileNotFoundError(
+        raise NoUsableCheckpointError(
             f"no checkpoint slot on disk for {path!r} — nothing to "
             "finalize on abort")
     verify_checkpoint(newest)
