@@ -462,6 +462,10 @@ def _bench_round(trainer, ci, *, reps, with_comm=False, with_staging=False,
     if trainer.cfg.async_rounds:
         fields["max_staleness"] = int(trainer.cfg.max_staleness)
         fields["admission_rejected"] = int(trainer._async_rejected)
+    # elastic federation: a churned roster changes the work per round, so
+    # the live-member count must ride next to any throughput number
+    if trainer.faults.churn_enabled:
+        fields["members_active"] = int(trainer._members.sum())
     if with_comm and trainer.algo.communicates:
         fields["bytes_on_wire"] = reps * trainer.round_bytes_on_wire(N, K)
         fields["bytes_dense"] = reps * 4 * N * K
@@ -536,6 +540,18 @@ def _measure(out: dict, progress=lambda: None) -> None:
     # nonzero here = the headline's timed reps recompiled (perf numbers
     # then include trace time and are not comparable run-to-run)
     out["jit_retraces"] = trainer._sentinel.retraces
+    # elastic-federation posture of this run: whether reshape resume and
+    # bounded barriers were armed, and whether any collective actually
+    # tripped the timeout (nonzero = the numbers above span a reshape)
+    from federated_pytorch_test_tpu.parallel.mesh import (
+        barrier_timeout, collective_timeout_count)
+    out["elastic"] = {
+        "elastic_resume": bool(trainer.cfg.elastic_resume),
+        "barrier_timeout_s": float(barrier_timeout()),
+        "collective_timeouts": int(collective_timeout_count()),
+        "members_joined": int(trainer._members_joined),
+        "members_left": int(trainer._members_left),
+    }
     progress()
 
     # full-net epoch (the no_consensus driver's path): every parameter

@@ -161,14 +161,76 @@ def check_supervisor_records(records: List[Dict[str, Any]],
     return len(sup)
 
 
+def _segment_mesh(segment: List[Dict[str, Any]]) -> Optional[int]:
+    header = next((r for r in segment
+                   if r.get("event") == "run_header"), None)
+    mesh = (header or {}).get("mesh_shape")
+    if isinstance(mesh, dict) and isinstance(mesh.get("clients"), int):
+        return mesh["clients"]
+    return None
+
+
+def check_reshape_records(segments: List[List[Dict[str, Any]]],
+                          errors: List[str]) -> int:
+    """Verify supervisor ``reshape`` records against the mesh headers.
+
+    The elastic-federation contract: every mesh-size change between
+    consecutive segments must be announced by EXACTLY ONE ``reshape``
+    control record in the dying segment, whose ``from_value`` is that
+    segment's header mesh and ``to_value`` the next segment's — a
+    dropped or tampered record is a replay divergence (exit 1), like
+    any other decision.  A reshape record in the final segment (no
+    successor header to check against) is left unverified: the
+    resumed process may simply have been killed before its header.
+    """
+    checked = 0
+    for si, segment in enumerate(segments):
+        reshapes = [r for r in segment if r.get("event") == "control"
+                    and r.get("source") == "supervisor"
+                    and r.get("intervention") == "reshape"]
+        checked += len(reshapes)
+        d_here = _segment_mesh(segment)
+        d_next = (_segment_mesh(segments[si + 1])
+                  if si + 1 < len(segments) else None)
+        if d_here is None or d_next is None:
+            continue
+        if d_here != d_next:
+            if not reshapes:
+                errors.append(
+                    f"segment {si}: mesh reshaped {d_here} -> {d_next} "
+                    "devices with NO reshape control record in the dying "
+                    "segment (record dropped?)")
+                continue
+            if len(reshapes) > 1:
+                errors.append(
+                    f"segment {si}: {len(reshapes)} reshape records for "
+                    "one mesh change (expected exactly one)")
+            rec = reshapes[0]
+            if (rec.get("from_value") != d_here
+                    or rec.get("to_value") != d_next):
+                errors.append(
+                    f"segment {si}: reshape record says "
+                    f"{rec.get('from_value')!r} -> {rec.get('to_value')!r}"
+                    f" but the run headers say {d_here} -> {d_next} "
+                    "(record tampered?)")
+        elif reshapes:
+            errors.append(
+                f"segment {si}: reshape record(s) present but the next "
+                f"segment resumed on the SAME {d_here}-device mesh "
+                "(record forged?)")
+    return checked
+
+
 def replay(records: List[Dict[str, Any]]) -> Tuple[List[str], Dict[str, int]]:
     """Full replay check; returns (errors, stats)."""
     errors: List[str] = []
     segments = segment_stream(records)
     n_policy = check_policy_records(segments, errors)
     n_sup = check_supervisor_records(records, errors)
+    n_reshape = check_reshape_records(segments, errors)
     return errors, {"segments": len(segments), "policy_records": n_policy,
-                    "supervisor_records": n_sup}
+                    "supervisor_records": n_sup,
+                    "reshape_records": n_reshape}
 
 
 def selftest() -> str:
@@ -190,18 +252,20 @@ def selftest() -> str:
               "fused_collective": False, "async_rounds": False,
               "health_window": 8, "seed": 0, "restart_backoff": 1.0}
 
-    def synth(d: str, rounds) -> str:
-        rec = make_recorder("jsonl", d, run_name="ctl-selftest",
+    def synth(d: str, rounds, mesh: Optional[int] = None,
+              name: str = "ctl-selftest") -> str:
+        rec = make_recorder("jsonl", d, run_name=name,
                             engine="selftest", algorithm="fedavg")
         controller_from_config(config, recorder=rec)
-        rec.open(config=config)
+        rec.open(config=config,
+                 mesh_shape=None if mesh is None else {"clients": mesh})
         for i, comm in enumerate(rounds):
             rec.round({"round_index": i, "nloop": 0, "block": 0,
                        "nadmm": i, "N": 10, "loss": 1.0, "rho": 1.0,
                        "round_seconds": 1.0, "comm_seconds": comm,
                        "images": 256})
         rec.close()
-        return os.path.join(d, "ctl-selftest.jsonl")
+        return os.path.join(d, f"{name}.jsonl")
 
     with tempfile.TemporaryDirectory() as d:
         # comm fraction 0.8 for 2 rounds trips the eager preset's
@@ -256,6 +320,31 @@ def selftest() -> str:
         errors6, _ = replay(records
                             + [dict(sup, backoff_seconds=good + 1.0)])
         assert errors6 and "seeded formula" in errors6[0], errors6
+
+        # elastic reshape verification: a two-segment stream whose mesh
+        # shrinks 8 -> 4 with the matching reshape record replays clean;
+        # tampering the record or dropping it is a divergence
+        d3 = os.path.join(d, "reshape")
+        os.makedirs(d3, exist_ok=True)
+        seg_a = read_records(synth(d3, [0.1, 0.1], mesh=8, name="seg-a"))
+        seg_b = read_records(synth(d3, [0.1], mesh=4, name="seg-b"))
+        reshape = {"event": "control", "schema": SCHEMA_VERSION,
+                   "run_id": "x", "round_index": 1,
+                   "source": "supervisor", "mode": "act", "applied": True,
+                   "intervention": "reshape", "param": "num_devices",
+                   "from_value": 8, "to_value": 4, "scope": "restart",
+                   "attempt": 1, "reason": "selftest preemption"}
+        elastic = seg_a + [sup, reshape] + seg_b
+        errors7, stats7 = replay(elastic)
+        assert not errors7, errors7
+        assert stats7["reshape_records"] == 1, stats7
+        errors8, _ = replay(
+            [dict(r, to_value=3) if r.get("intervention") == "reshape"
+             else r for r in elastic])
+        assert errors8 and "tampered" in errors8[0], errors8
+        errors9, _ = replay(
+            [r for r in elastic if r.get("intervention") != "reshape"])
+        assert errors9 and "dropped" in errors9[0], errors9
         json.dumps(stats)  # stats stay JSON-representable
     return "control replay selftest: OK (decisions reproduce; tampering detected)"
 
@@ -289,8 +378,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         for e in errors:
             print(f"  - {e}")
         return 1
-    print(f"replay OK: {stats['policy_records']} policy decision(s) and "
-          f"{stats['supervisor_records']} supervisor record(s) reproduce "
+    print(f"replay OK: {stats['policy_records']} policy decision(s), "
+          f"{stats['supervisor_records']} supervisor record(s) and "
+          f"{stats['reshape_records']} reshape record(s) reproduce "
           f"across {stats['segments']} segment(s)")
     return 0
 

@@ -47,6 +47,7 @@ from federated_pytorch_test_tpu.control.policy import (
 from federated_pytorch_test_tpu.obs.health import RunHealthAbort
 from federated_pytorch_test_tpu.obs.schema import (
     SCHEMA_VERSION, validate_record)
+from federated_pytorch_test_tpu.parallel.mesh import CollectiveTimeoutError
 from federated_pytorch_test_tpu.utils.checkpoint import (
     CheckpointCorruptError, NoUsableCheckpointError)
 
@@ -54,8 +55,13 @@ from federated_pytorch_test_tpu.utils.checkpoint import (
 #: of the run seed (stateless-seed idiom, see utils/serialization notes)
 _BACKOFF_TAG = 0xC791
 
-#: exceptions the supervisor always converts into a restart attempt
-RETRYABLE = (RunHealthAbort, ControlRestart, CheckpointCorruptError)
+#: exceptions the supervisor always converts into a restart attempt.
+#: CollectiveTimeoutError is the preemption signal (a peer lost mid-
+#: collective, or the simulated preempt= fault family) — under
+#: cfg.elastic_resume the classifier supervisor additionally reshapes
+#: the mesh before resuming (see supervise_classifier's reshape rung).
+RETRYABLE = (RunHealthAbort, ControlRestart, CheckpointCorruptError,
+             CollectiveTimeoutError)
 
 
 class RestartBudgetExhausted(RuntimeError):
@@ -133,6 +139,21 @@ DEGRADATION_LADDER: Tuple[Tuple[str, Callable], ...] = (
 )
 
 
+def surviving_device_count(devices: int, K: int) -> int:
+    """Largest device count ``d < devices`` with ``K % d == 0``.
+
+    The reshape rung's target mesh after a preemption: losing any slice
+    of a ``devices``-chip mesh leaves at most ``devices - 1`` usable,
+    and the client axis needs ``K`` divisible by the mesh size.  Returns
+    ``devices`` unchanged when no smaller divisor exists (a 1-device
+    mesh has nothing to shrink to — the restart resumes in place).
+    """
+    for d in range(min(devices - 1, K), 0, -1):
+        if K % d == 0:
+            return d
+    return devices
+
+
 def ladder_overrides(cfg, attempt: int):
     """Config after the ladder for restart ``attempt`` (1-based).
 
@@ -187,6 +208,11 @@ def _failure_round(exc: BaseException) -> int:
     if isinstance(decision, dict) and isinstance(
             decision.get("round_index"), int):
         return decision["round_index"]
+    # CollectiveTimeoutError carries the round directly (no alert dict:
+    # a hung collective never reached the telemetry layer)
+    ridx = getattr(exc, "round_index", None)
+    if isinstance(ridx, int):
+        return ridx
     return -1
 
 
@@ -215,12 +241,14 @@ def supervise(run_attempt: Callable[[int, bool], Any], *,
     ``retry_on`` extras) consumes one unit of restart budget; anything
     else propagates untouched.
 
-    ``describe(attempt)`` (optional) returns
+    ``describe(attempt, exc)`` (optional) returns
     ``(jsonl_path, run_id_hint, extra_records)`` for the segment that
     just failed so restart/terminal records land in its stream —
     classifier runs use :func:`supervise_classifier` which wires this to
-    the trainer's recorder; bare callers may pass None and get
-    log-only supervision (CPC/VAE path).
+    the trainer's recorder (``exc`` lets its reshape rung react to the
+    failure TYPE, not just the count); bare callers may pass None and
+    get log-only supervision (CPC/VAE path).  A one-argument
+    ``describe(attempt)`` keeps working (pre-reshape callers).
     """
     retryable = RETRYABLE + tuple(retry_on)
     attempt = 0
@@ -238,7 +266,10 @@ def supervise(run_attempt: Callable[[int, bool], Any], *,
             jsonl_path, run_id, extra = (None, "", [])
             if describe is not None:
                 try:
-                    jsonl_path, run_id, extra = describe(attempt)
+                    try:
+                        jsonl_path, run_id, extra = describe(attempt, e)
+                    except TypeError:       # legacy one-arg describe
+                        jsonl_path, run_id, extra = describe(attempt)
                 except Exception:
                     jsonl_path, run_id, extra = (None, "", [])
             if attempt > max_restarts:
@@ -292,6 +323,14 @@ def supervise_classifier(build_trainer, cfg, checkpoint_path: str, *,
             stage, degraded, changes = ladder_overrides(cfg, attempt - 1)
             box["stage"], box["cfg"] = stage, degraded
             box["changes"] = changes
+        if box.get("reshape_to"):
+            # reshape rung (elastic federation): a CollectiveTimeoutError
+            # marked the mesh as having lost a slice — rebuild the
+            # trainer over the surviving device count recorded by
+            # describe(); sticky across later attempts (the lost slice
+            # does not come back mid-run)
+            box["cfg"] = dataclasses.replace(
+                box["cfg"], num_devices=box["reshape_to"])
         trainer = build_trainer(box["cfg"], attempt)
         box["trainer"] = trainer
         st = (state if attempt == 1 and state is not None
@@ -299,7 +338,7 @@ def supervise_classifier(build_trainer, cfg, checkpoint_path: str, *,
         return trainer.run(st, checkpoint_path=checkpoint_path,
                            resume=resume or resume_now, **kwargs)
 
-    def describe(attempt: int):
+    def describe(attempt: int, exc: Optional[BaseException] = None):
         trainer = box["trainer"]
         rec = getattr(trainer, "obs_recorder", None)
         jsonl_path = getattr(rec, "jsonl_path", None)
@@ -307,7 +346,30 @@ def supervise_classifier(build_trainer, cfg, checkpoint_path: str, *,
         ridx = getattr(rec, "_last_index", -1)
         if not isinstance(ridx, int):
             ridx = -1
+        if ridx < 0:
+            ridx = max(-1, _failure_round(exc) if exc is not None else -1)
         extra: List[Dict[str, Any]] = []
+        if (isinstance(exc, CollectiveTimeoutError)
+                and getattr(box["cfg"], "elastic_resume", False)
+                and trainer is not None):
+            # reshape rung: the timeout says a slice is gone — resume
+            # the newest checkpoint onto the largest surviving mesh that
+            # still divides the client axis, and append the typed
+            # `reshape` decision to the dying segment's stream so
+            # control.replay can verify it against the next segment's
+            # run_header mesh_shape
+            d_here = int(box.get("reshape_to") or trainer.D)
+            d_next = surviving_device_count(d_here, cfg.K)
+            if d_next != d_here:
+                box["reshape_to"] = d_next
+                extra.append(dict(
+                    _base_record(run_id or "unknown", ridx),
+                    intervention="reshape", param="num_devices",
+                    from_value=d_here, to_value=d_next, scope="restart",
+                    attempt=attempt,
+                    reason=f"CollectiveTimeoutError: resume from the "
+                           f"newest checkpoint on the surviving "
+                           f"{d_next}-device mesh"))
         if attempt <= max(0, cfg.max_restarts):
             # `attempt` here is the restart number about to run; its
             # ladder stage is recorded against the segment that just

@@ -55,8 +55,10 @@ def build_parser(defaults: FederatedConfig, prog: str) -> argparse.ArgumentParse
                 help="fault-injection spec: 'none' or "
                      "drop=P,straggle=P,corrupt=P,mode=nan|inf|signflip|"
                      "scale|innerprod|collude,scale=X,seed=N,clients=i+j,"
-                     "delay=P,delay_max=N (train/faults.py; delay= drives "
-                     "--async-rounds arrival times)")
+                     "delay=P,delay_max=N,join=P,leave=P,preempt=P "
+                     "(train/faults.py; delay= drives --async-rounds "
+                     "arrival times; join=/leave= drive the membership "
+                     "ledger, preempt= simulates mid-run preemption)")
         elif f.name == "model":
             p.add_argument(arg, choices=MODEL_CHOICES, default=default)
         elif f.name == "health_action":

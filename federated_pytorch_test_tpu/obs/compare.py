@@ -103,6 +103,26 @@ def load_source(path: str) -> Dict[str, Any]:
             v = _num(s.get(k))
             if v is not None:
                 src["metrics"][k] = v
+        # elastic-federation membership (schema v9): info-direction
+        # metrics (unknown to _DIRECTION -> delta reported, never a
+        # verdict) — a churn run's roster is part of the experiment, so
+        # membership differences against a static baseline must show up
+        # in the diff without gating it
+        for k in ("members_peak", "members_min", "joined_total",
+                  "left_total"):
+            v = _num(s.get(k))
+            if v is not None:
+                src["metrics"][k] = v
+        if s.get("members_peak") is not None:
+            src["notes"].append(
+                f"dynamic membership (min {s.get('members_min')} / peak "
+                f"{s.get('members_peak')} live members): loss/throughput "
+                "diffs vs a static-roster baseline reflect the roster, "
+                "not just the code")
+        if s.get("reshapes"):
+            src["notes"].append(
+                f"{s['reshapes']} mesh reshape(s): segments ran on "
+                "different device counts; wall-clock metrics span both")
         # device-cost metrics (schema v6): present only when the run's
         # ledger emitted them, so pre-v6 streams compare unchanged
         for k, val in profile_metrics(records).items():

@@ -133,6 +133,16 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         out["staleness_hist_total"] = total
     else:
         out["staleness_hist_total"] = None
+    # elastic-federation membership (schema v9; join=/leave= families):
+    # peak/min live members over the run, total transitions, and the
+    # reshape count from the supervisor control records.  All None/0 on
+    # static-roster streams so pre-v9 summaries are unchanged.
+    members = [r["members_active"] for r in rounds
+               if isinstance(r.get("members_active"), int)]
+    out["members_peak"] = max(members) if members else None
+    out["members_min"] = min(members) if members else None
+    out["joined_total"] = tot("joined")
+    out["left_total"] = tot("left")
     # watchdog alerts (schema v5)
     alerts = [r for r in records if r.get("event") == "alert"]
     out["alerts"] = len(alerts)
@@ -144,6 +154,8 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         {c.get("intervention", "?") for c in controls})
     out["restarts"] = sum(1 for c in controls
                           if c.get("intervention") == "restart")
+    out["reshapes"] = sum(1 for c in controls
+                          if c.get("intervention") == "reshape")
     # device-cost ledger (schema v6): compile totals recomputed from the
     # round records; the memory watermark is the max across the rounds'
     # instantaneous stats (matches the recorder's summary field)
@@ -233,6 +245,12 @@ def format_report(s: Dict[str, Any]) -> str:
     if s.get("overlap_seconds_total"):
         row("comm overlap", f"{s['overlap_seconds_total']:.2f} s hidden "
             "behind staging")
+    if s.get("members_peak") is not None:
+        row("membership",
+            f"peak={s['members_peak']} min={s.get('members_min')} "
+            f"joined={s.get('joined_total') or 0} "
+            f"left={s.get('left_total') or 0} "
+            f"reshapes={s.get('reshapes') or 0}")
     if s.get("alerts"):
         row("health alerts",
             f"{s['alerts']} alert(s): {', '.join(s.get('alert_rules') or [])}")
@@ -284,7 +302,9 @@ def selftest() -> str:
                        "quarantined": 0,
                        "async_mode": True, "max_staleness": 2,
                        "async_arrived": 2, "admission_rejected": i,
-                       "buffer_depth": i, "staleness_hist": [2, 0, 0]})
+                       "buffer_depth": i, "staleness_hist": [2, 0, 0],
+                       "members_active": 2 - (i == 1), "joined": 0,
+                       "left": 1 if i == 1 else 0})
         rec.close()
         path = os.path.join(d, "selftest.jsonl")
         records = read_records(path)
@@ -303,10 +323,14 @@ def selftest() -> str:
         assert s["staleness_hist_total"] == [6, 0, 0], s
         assert s["bytes_fused_total"] == 150, s
         assert abs(s["overlap_seconds_total"] - 0.06) < 1e-9, s
+        assert s["members_peak"] == 2 and s["members_min"] == 1, s
+        assert s["joined_total"] == 0 and s["left_total"] == 1, s
+        assert s["reshapes"] == 0, s
         table = format_report(s)
         assert "async" in table, table
         assert "bytes fused" in table, table
         assert "comm overlap" in table, table
+        assert "membership" in table, table
     assert record_ips({"images": 256, "round_seconds": 0}) == float("inf")
     assert record_ips({"images": 0, "round_seconds": 0}) == 0.0
 

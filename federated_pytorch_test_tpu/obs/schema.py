@@ -97,8 +97,17 @@ from typing import Any, Dict
 # recorded telemetry + round index, so control.replay can re-derive
 # the decision sequence bit-exactly from the stream.  The summary
 # gains `interventions_total`.
-# v1..v7 records remain valid: validate_record accepts ver <= SCHEMA_VERSION.
-SCHEMA_VERSION = 8
+# v9 (additive): elastic federation (train/faults.py churn families +
+# mesh-reshaping resume) — round records gain `members_active` (live
+# churn-ledger members after this round's tick), `joined` and `left`
+# (this round's membership transitions).  Present only when a
+# join=/leave= fault family is configured, so static-roster streams are
+# byte-identical to v8.  Reshape restarts reuse the existing v8 control
+# fields (`intervention="reshape"`, param/from_value/to_value/scope/
+# attempt/reason); control.replay cross-checks them against consecutive
+# run_header `mesh_shape` values.
+# v1..v8 records remain valid: validate_record accepts ver <= SCHEMA_VERSION.
+SCHEMA_VERSION = 9
 
 EVENTS = ("run_header", "round", "summary", "span", "alert", "compile",
           "control")
@@ -188,6 +197,10 @@ FIELDS: Dict[str, Any] = {
     "fault_dropped": (("round",), _INT),
     "fault_straggled": (("round",), _INT),
     "fault_corrupted": (("round",), _INT),
+    # elastic federation churn ledger (schema v9; join=/leave= families)
+    "members_active": (("round",), _INT),
+    "joined":       (("round",), _INT),
+    "left":         (("round",), _INT),
     # buffered-async federation (schema v4; --async-rounds)
     "async_mode":   (("round",), _BOOL),
     "max_staleness": (("round",), _INT),

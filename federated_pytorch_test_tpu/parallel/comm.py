@@ -26,7 +26,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from federated_pytorch_test_tpu.parallel.mesh import CLIENT_AXIS
+from federated_pytorch_test_tpu.parallel.mesh import (    # noqa: F401
+    CLIENT_AXIS, CollectiveTimeoutError, bounded_wait)
+# CollectiveTimeoutError/bounded_wait re-exported here: comm.py is the
+# collective entry-point module callers import, and the bounded-wait
+# wrapper (parallel/mesh.py) is how a hung multi-process collective
+# surfaces as a typed error instead of an infinite wedge.
 
 #: CLI surface — drivers/common.py derives --robust-agg choices from this
 #: so the flag and the factory cannot drift.

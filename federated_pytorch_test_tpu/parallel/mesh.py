@@ -14,7 +14,10 @@ the same code on a virtual 8-device CPU mesh (tests/conftest.py).
 from __future__ import annotations
 
 import functools
-from typing import Optional, Sequence
+import os
+import threading
+import time
+from typing import Callable, Optional, Sequence
 
 import jax
 import numpy as np
@@ -134,6 +137,185 @@ def _process_count() -> int:
     return jax.process_count()
 
 
+# ---------------------------------------------------------------------------
+# preemption-tolerant collectives — a peer process lost to preemption leaves
+# every cross-process barrier/all-gather hung forever (jax.distributed's own
+# heartbeat takes ~100s to notice, and the stock collectives have no
+# deadline).  bounded_wait() converts that wedge into a typed error the
+# restart supervisor can act on (reshape rung).  Default-off: timeout 0 runs
+# the LITERAL unwrapped call — no helper thread, bit-identical, so the
+# single-host and default multi-host paths are untouched.
+# ---------------------------------------------------------------------------
+
+class CollectiveTimeoutError(RuntimeError):
+    """A multi-process collective or barrier exceeded its bounded wait —
+    the signature of a peer lost to preemption (or a wedged relay).
+    ``round_index`` (when known) lets the restart supervisor attribute
+    the failure to a round without parsing the message."""
+
+    def __init__(self, message: str, round_index: Optional[int] = None):
+        super().__init__(message)
+        self.round_index = round_index
+
+
+def _env_barrier_timeout() -> float:
+    try:
+        return float(os.environ.get("FEDTPU_BARRIER_TIMEOUT", "0") or 0.0)
+    except ValueError:
+        return 0.0
+
+
+#: active bound in seconds; <= 0 disables.  Seeded from the env so bare
+#: scripts can arm it; engines override from cfg.barrier_timeout.
+_BARRIER_TIMEOUT: float = _env_barrier_timeout()
+
+#: (monotonic stamp, site name) of the last collective that COMPLETED —
+#: the age of this record at timeout time says how long the process had
+#: already been making progress-free.
+_HEARTBEAT = {"stamp": None, "name": None}
+
+#: elastic-collective state, touched from both the main thread and the
+#: async checkpoint writer (its slot barriers route through
+#: ``sync_global``), hence the lock:
+#:   timeouts — process-lifetime count of bounded waits that expired
+#:              (bench/obs counters)
+#:   seq      — sequence number appended to coordination-service barrier
+#:              ids; the service requires a fresh id per barrier
+#:              instance, and SPMD guarantees every process issues the
+#:              same barrier sequence, so the counter stays agreed
+#:              across the job
+_ELASTIC = {"timeouts": 0, "seq": 0}
+_ELASTIC_LOCK = threading.Lock()
+
+
+def configure_barrier_timeout(seconds: float) -> float:
+    """Set the global bounded-wait deadline; returns the previous value.
+    <= 0 disables (the literal unwrapped call path)."""
+    global _BARRIER_TIMEOUT
+    prev = _BARRIER_TIMEOUT
+    _BARRIER_TIMEOUT = float(seconds)
+    return prev
+
+
+def barrier_timeout() -> float:
+    return _BARRIER_TIMEOUT
+
+
+def collective_timeout_count() -> int:
+    return _ELASTIC["timeouts"]
+
+
+def heartbeat(name: str) -> None:
+    """Record that collective site ``name`` just completed."""
+    _HEARTBEAT["stamp"] = time.monotonic()
+    _HEARTBEAT["name"] = name
+
+
+def last_heartbeat_age() -> Optional[float]:
+    """Seconds since any collective last completed (None: none yet)."""
+    stamp = _HEARTBEAT["stamp"]
+    return None if stamp is None else time.monotonic() - stamp
+
+
+def bounded_wait(fn: Callable, *, name: str,
+                 timeout: Optional[float] = None):
+    """Run blocking collective ``fn()`` with a deadline.
+
+    With the effective timeout <= 0 (the default) this IS ``fn()`` — no
+    thread, no wrapping.  Otherwise ``fn`` runs on a daemon thread and a
+    ``join(timeout)`` bounds the wait: on expiry a
+    :class:`CollectiveTimeoutError` carries the site name, the bound,
+    and the last-heartbeat age.  The stuck daemon thread is abandoned —
+    by construction the process is about to unwind to the restart
+    supervisor (or die), and a hung XLA collective cannot be cancelled
+    from python anyway.
+    """
+    t = _BARRIER_TIMEOUT if timeout is None else float(timeout)
+    if t <= 0:
+        out = fn()
+        heartbeat(name)
+        return out
+    box: dict = {}
+
+    def runner():
+        try:
+            box["value"] = fn()
+        except BaseException as e:          # surface peer-side failures too
+            box["error"] = e
+
+    th = threading.Thread(target=runner, name=f"bounded-{name}", daemon=True)
+    th.start()
+    th.join(t)
+    if th.is_alive():
+        with _ELASTIC_LOCK:
+            _ELASTIC["timeouts"] += 1
+        age = last_heartbeat_age()
+        last = ("no collective had completed yet" if age is None else
+                f"last completed collective was {_HEARTBEAT['name']!r} "
+                f"{age:.1f}s ago")
+        raise CollectiveTimeoutError(
+            f"collective {name!r} did not complete within {t:.1f}s "
+            f"(process {jax.process_index()}/{_process_count()}; {last}) "
+            "— peer lost to preemption?")
+    if "error" in box:
+        raise box["error"]
+    heartbeat(name)
+    return box.get("value")
+
+
+def sync_global(tag: str, timeout: Optional[float] = None) -> None:
+    """Cross-process barrier with the bounded wait applied.
+
+    The shared entry point for every host-side barrier (checkpoint slot
+    surgery, round fences).  No-op single-process, exactly like the raw
+    ``sync_global_devices`` call it replaces.
+
+    With a positive bound the barrier runs on the coordination service
+    (``wait_at_barrier``): a pure-RPC rendezvous with a server-side
+    deadline that works on every backend — the XLA barrier cannot be
+    deadlined, and on the CPU backend it cannot even run cross-process.
+    A missing peer (preemption) surfaces as the typed
+    :class:`CollectiveTimeoutError` at the bound.  Timeout <= 0 keeps
+    the stock XLA ``sync_global_devices`` path bit-for-bit.
+    """
+    if _process_count() == 1:
+        return
+    t = _BARRIER_TIMEOUT if timeout is None else float(timeout)
+    if t > 0:
+        from jax._src.distributed import global_state
+
+        client = getattr(global_state, "client", None)
+        if client is not None:
+            with _ELASTIC_LOCK:
+                _ELASTIC["seq"] += 1
+                seq = _ELASTIC["seq"]
+            name = f"sync:{tag}"
+            try:
+                client.wait_at_barrier(f"fedtpu:{tag}:{seq}",
+                                       int(t * 1000))
+            except Exception as e:
+                with _ELASTIC_LOCK:
+                    _ELASTIC["timeouts"] += 1
+                age = last_heartbeat_age()
+                last = ("no collective had completed yet" if age is None
+                        else f"last completed collective was "
+                             f"{_HEARTBEAT['name']!r} {age:.1f}s ago")
+                raise CollectiveTimeoutError(
+                    f"collective {name!r} did not complete within "
+                    f"{t:.1f}s (process {jax.process_index()}/"
+                    f"{_process_count()}; {last}) — peer lost to "
+                    "preemption?") from e
+            heartbeat(name)
+            return
+
+    def _sync():
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
+    bounded_wait(_sync, name=f"sync:{tag}", timeout=t)
+
+
 def stage_global(x, sharding: NamedSharding):
     """Host array -> global device array under ``sharding``.
 
@@ -174,9 +356,15 @@ def fetch(x):
     """
     if _process_count() == 1:
         return np.asarray(x)
-    from jax.experimental import multihost_utils
 
-    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    def _gather():
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+    # cross-process all-gather: a preempted peer would hang this forever,
+    # so it goes through the bounded wait (no-op at the default timeout 0)
+    return bounded_wait(_gather, name="fetch:allgather")
 
 
 def local_client_rows(mesh: Mesh, K: int) -> list:

@@ -82,8 +82,27 @@ class FederatedConfig:
     # encode(x_k - z) boundary, and late delivery (delay=, async mode
     # only).  "none" = no faults (reference parity).  Grammar:
     #   drop=P,straggle=P,corrupt=P,mode=M,scale=X,seed=N,clients=i+j,
-    #   delay=P,delay_max=N
+    #   delay=P,delay_max=N,join=P,leave=P,preempt=P
     fault_spec: str = "none"
+
+    # elastic federation (mesh-reshaping resume): allow a checkpoint
+    # written on a D-device mesh to restore onto a D'-device mesh — the
+    # [K, ...] client stack restages onto the surviving mesh (K % D' must
+    # still divide), replicated server state re-lays out, and the jitted
+    # fns rebuild over the new geometry.  Off by default: a wrong-D
+    # resume then fails with a typed CheckpointGeometryError instead of
+    # silently continuing on different hardware (PARITY.md: bitwise when
+    # D' == D, allclose + exact history semantics when D' != D).
+    elastic_resume: bool = False
+
+    # preemption-tolerant collectives (parallel/mesh.py bounded_wait):
+    # bound every multi-process barrier/collective entry point by this
+    # many seconds — a peer process lost to preemption then surfaces as
+    # a typed CollectiveTimeoutError (which the restart supervisor's
+    # reshape rung can act on) instead of an infinite wedge.  0 = off
+    # (the literal unwrapped call — default path bit-identical and
+    # thread-free).  Also settable via env FEDTPU_BARRIER_TIMEOUT.
+    barrier_timeout: float = 0.0
 
     # robust aggregation (parallel/comm.py robust_federated_mean):
     # drop-in alternatives to the plain psum mean — coordinate-wise

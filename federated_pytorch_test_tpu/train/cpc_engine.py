@@ -76,10 +76,15 @@ class CPCTrainer:
                  lbfgs_max_iter: int = 2, Niter: int = 10,
                  init_seed: int = 0, num_devices: Optional[int] = None,
                  sanitize: bool = False, retrace_sentinel: bool = False,
-                 donate: Optional[bool] = None, cost_ledger: bool = True):
+                 donate: Optional[bool] = None, cost_ledger: bool = True,
+                 elastic_resume: bool = False):
         self.data = data
         self.K = data.K
         self.Niter = Niter
+        # mesh-reshaping resume (classifier-engine cfg.elastic_resume
+        # parity): allow a checkpoint written on a different device count
+        # to restage onto this mesh instead of failing geometry validation
+        self.elastic_resume = bool(elastic_resume)
         # buffer donation (classifier-engine parity; None = auto: on for
         # accelerator backends): the jitted round donates state/z/
         # opt_state — all rebound from its outputs — so XLA reuses the
@@ -326,6 +331,7 @@ class CPCTrainer:
     def _save_midrun(self, path, state: CPCState, z, opt_state, px, py,
                      nxt, history) -> None:
         from federated_pytorch_test_tpu.utils.checkpoint import (
+            mesh_geometry_meta,
             pack_history,
             save_checkpoint_swapped,
             snapshot_to_host,
@@ -351,6 +357,10 @@ class CPCTrainer:
             "data_round": len(history),
             "history": pack_history(history),
         }
+        # geometry stamp (classifier-engine parity): every slot knows the
+        # mesh that wrote it, so resume validates before any device_put
+        meta.update(mesh_geometry_meta(
+            devices=self.D, processes=jax.process_count(), K=self.K))
         if self._ckpt_writer is not None:
             # async: materialize a host copy first (donation-safe — the
             # device buffers may be reused by the next round's dispatch),
@@ -371,9 +381,15 @@ class CPCTrainer:
             load_checkpoint,
             restore_leaves,
             unpack_history,
+            validate_geometry,
         )
 
         tree, meta = load_checkpoint(path)
+        # geometry gate first (classifier-engine parity): a wrong-D slot
+        # dies with the typed error unless elastic_resume restages it
+        validate_geometry(meta, devices=self.D,
+                          processes=jax.process_count(), K=self.K,
+                          elastic=self.elastic_resume)
         csh = client_sharding(self.mesh)
         state = CPCState(**{k: stage_tree_global(tree[k], csh)
                             for k in SUBMODELS})
@@ -469,6 +485,7 @@ class CPCTrainer:
         )
         from federated_pytorch_test_tpu.utils.checkpoint import (
             CheckpointCorruptError,
+            CheckpointGeometryError,
             checkpoint_slots,
             finalize_checkpoint,
             verify_checkpoint,
@@ -498,6 +515,10 @@ class CPCTrainer:
                 verify_checkpoint(slot)      # raises on checksum mismatch
                 state, r_z, r_opt, resume_at, history = \
                     self._restore_midrun(slot)
+            except CheckpointGeometryError:
+                # every slot shares the writer's geometry — falling back
+                # cannot fix a mesh mismatch; surface the typed error
+                raise
             except Exception as e:           # corrupt/truncated slot:
                 failures.append(f"{slot}: {e}")     # fall back, don't die
                 log(f"WARNING: checkpoint slot {slot} is unusable ({e}); "

@@ -246,6 +246,26 @@ class BlockwiseFederatedTrainer:
         self._async_arrival = np.full(cfg.K, -1, np.int64)
         self._async_birth = np.zeros(cfg.K, np.int64)
         self._async_rejected = 0
+        # elastic-federation state: the [K] bool churn membership ledger
+        # (everyone present at start; join=/leave= fault families advance
+        # it once per round in _round_activity) and the one-shot arming
+        # flag for simulated preemption (preempt= draws are deterministic
+        # in the round coordinates, so a resumed segment must disarm them
+        # or the same round would re-fire forever).  The ledger rides in
+        # the mid-run checkpoint meta like the quarantine/async ledgers.
+        self._members = np.ones(cfg.K, bool)
+        self._rejoined_mask = np.zeros(cfg.K, bool)
+        self._members_joined = 0
+        self._members_left = 0
+        self._preempt_armed = True
+        if cfg.barrier_timeout < 0:
+            raise ValueError(
+                f"barrier_timeout={cfg.barrier_timeout} must be >= 0 "
+                "(0 disables the bounded wait)")
+        if cfg.barrier_timeout > 0:
+            from federated_pytorch_test_tpu.parallel.mesh import (
+                configure_barrier_timeout)
+            configure_barrier_timeout(cfg.barrier_timeout)
 
         self.order = model.param_order()
         self.block_ids = model.train_order_block_ids()
@@ -1097,8 +1117,12 @@ class BlockwiseFederatedTrainer:
         round's FRACTIONAL staleness weights instead of a 0/1 mask.
         """
         cfg, faults = self.cfg, self.faults
+        # the churn ledger ticks exactly once per round, BEFORE the async
+        # delegation, so both schedulers see the same membership
+        churn_counts = self._membership_tick(nloop, ci, nadmm)
         if cfg.async_rounds:
-            return self._round_activity_async(nloop, ci, nadmm)
+            return self._round_activity_async(nloop, ci, nadmm,
+                                              churn_counts)
         quarantined = int(np.sum(self._quarantine > 0))
         if not faults.enabled and quarantined == 0:
             if cfg.participation >= 1.0:
@@ -1109,6 +1133,11 @@ class BlockwiseFederatedTrainer:
             return dev, dev, self._zero_corrupt, host, {}
         base = (np.ones(cfg.K, np.float32) if cfg.participation >= 1.0
                 else self._participation_host(nloop, ci, nadmm))
+        if faults.churn_enabled:
+            # a departed client is out of the round entirely — not
+            # sampled, not faulted, not counted; the mean renormalizes
+            # over live members through the usual psum(w) denominator
+            base = base * self._members.astype(np.float32)
         ok = 1.0 - (self._quarantine > 0).astype(np.float32)
         drop = straggle = corrupt = np.zeros(cfg.K, np.float32)
         if faults.enabled:
@@ -1123,11 +1152,88 @@ class BlockwiseFederatedTrainer:
                 fault_dropped=int(np.sum(base * ok * drop)),
                 fault_straggled=int(np.sum(comm * straggle)),
                 fault_corrupted=int(np.sum(corrupt)))
+        counts.update(churn_counts)
         csh = client_sharding(self.mesh)
         return (stage_global(train, csh), stage_global(comm, csh),
                 stage_global(corrupt, csh), comm, counts)
 
-    def _round_activity_async(self, nloop: int, ci: int, nadmm: int):
+    def _membership_tick(self, nloop: int, ci: int, nadmm: int) -> dict:
+        """Advance the churn membership ledger by one round.
+
+        Pure bookkeeping around ``FaultSpec.round_churn`` (the seeded
+        draw): departed clients have their quarantine sentence voided
+        and any in-flight async update dropped (the update's sender no
+        longer exists); rejoining clients get their compressor/EF rows
+        re-initialized by the round loop (``_rejoined_mask``) — a
+        returning client is a NEW client with the current server state,
+        not a ghost resuming a stale residual.  Returns the round-record
+        counts (empty when churn is off, keeping v8 records byte-
+        identical)."""
+        faults = self.faults
+        if not faults.churn_enabled:
+            return {}
+        prev = self._members
+        self._members = faults.round_churn(prev, nloop, ci, nadmm)
+        joined = self._members & ~prev
+        left = prev & ~self._members
+        if left.any():
+            self._quarantine[left] = 0
+            self._async_arrival[left] = -1
+            self._async_birth[left] = 0
+        self._rejoined_mask = joined
+        self._members_joined += int(joined.sum())
+        self._members_left += int(left.sum())
+        return {"members_active": int(self._members.sum()),
+                "joined": int(joined.sum()),
+                "left": int(left.sum())}
+
+    def _maybe_preempt(self, nloop: int, ci: int, nadmm: int,
+                       rounds_done: int, checkpoint_path) -> None:
+        """Simulated slice preemption (fault family ``preempt=``).
+
+        Raises :class:`CollectiveTimeoutError` — the same type a real
+        hung collective produces under the bounded wait — so the restart
+        supervisor's reshape rung exercises identically for simulated
+        and genuine preemptions.  Fires only when armed (fresh segments:
+        the draw is deterministic in the round coordinates, so a resumed
+        segment replaying this round must not re-fire), only after at
+        least one round has checkpointed (there must be a recovery
+        point), and after the async writer has made that checkpoint
+        durable."""
+        faults = self.faults
+        if (faults.preempt <= 0.0 or not self._preempt_armed
+                or rounds_done == 0 or checkpoint_path is None):
+            return
+        if not faults.round_preempt(nloop, ci, nadmm):
+            return
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.wait()
+        from federated_pytorch_test_tpu.parallel.mesh import (
+            CollectiveTimeoutError)
+        raise CollectiveTimeoutError(
+            f"simulated preemption at round {rounds_done} "
+            f"(nloop={nloop}, block={ci}, nadmm={nadmm}): fault spec "
+            f"preempt={faults.preempt} drew this round",
+            round_index=rounds_done)
+
+    def _reset_comp_rows(self, comp, ci: int, mask: np.ndarray):
+        """Re-initialize the compressor/EF state rows of rejoining
+        clients to this block's fresh init (leaves whose leading axis is
+        not the client stack pass through untouched)."""
+        fresh = self._init_comp_state(ci)
+        m = stage_global(mask.astype(np.float32),
+                         client_sharding(self.mesh))
+
+        def sel(cur, new):
+            if getattr(cur, "ndim", 0) == 0 or cur.shape[0] != self.cfg.K:
+                return cur
+            mm = m.reshape((-1,) + (1,) * (cur.ndim - 1))
+            return jnp.where(mm > 0, new, cur)
+
+        return jax.tree.map(sel, comp, fresh)
+
+    def _round_activity_async(self, nloop: int, ci: int, nadmm: int,
+                              churn_counts: Optional[dict] = None):
         """Buffered-async round schedule (cfg.async_rounds).
 
         The server stops barriering: a free client sampled this round
@@ -1156,6 +1262,10 @@ class BlockwiseFederatedTrainer:
         K = cfg.K
         base = (np.ones(K, np.float32) if cfg.participation >= 1.0
                 else self._participation_host(nloop, ci, nadmm))
+        if faults.churn_enabled:
+            # departed clients neither dispatch nor deliver (the
+            # membership tick already voided their in-flight slots)
+            base = base * self._members.astype(np.float32)
         ok = 1.0 - (self._quarantine > 0).astype(np.float32)
         drop = straggle = corrupt = np.zeros(K, np.float32)
         if faults.enabled:
@@ -1201,6 +1311,7 @@ class BlockwiseFederatedTrainer:
                 fault_dropped=int(np.sum(base * ok * free * drop)),
                 fault_straggled=int(np.sum(dispatch * straggle)),
                 fault_corrupted=int(np.sum(corrupt)))
+        counts.update(churn_counts or {})
         csh = client_sharding(self.mesh)
         return (stage_global(train, csh), stage_global(w, csh),
                 stage_global(corrupt, csh), w, counts)
@@ -1441,6 +1552,7 @@ class BlockwiseFederatedTrainer:
     def _save_midrun(self, path, state: ClientState, blockvars, nxt,
                      history) -> None:
         from federated_pytorch_test_tpu.utils.checkpoint import (
+            mesh_geometry_meta,
             pack_history,
             save_checkpoint_swapped,
             snapshot_to_host,
@@ -1468,6 +1580,17 @@ class BlockwiseFederatedTrainer:
             "keys_staged": self._keys_staged,
             "history": pack_history(history),
         }
+        # mesh geometry + churn membership: every slot knows what
+        # hardware wrote it (validate_geometry gates the resume) and who
+        # was a member when it was cut — both ride the sync AND async
+        # writers identically since they are plain meta keys
+        meta.update(mesh_geometry_meta(
+            devices=self.D, processes=jax.process_count(), K=self.cfg.K,
+            members=self._members if self.faults.churn_enabled else None))
+        if self.faults.churn_enabled:
+            meta["members_joined"] = np.asarray(self._members_joined,
+                                                np.int64)
+            meta["members_left"] = np.asarray(self._members_left, np.int64)
         if self.cfg.update_guard:
             # guard state is host state: pending quarantine sentences and
             # the calibrated norm scale must survive a kill, or a resumed
@@ -1497,9 +1620,21 @@ class BlockwiseFederatedTrainer:
             load_checkpoint,
             restore_leaves,
             unpack_history,
+            validate_geometry,
         )
 
         tree, meta = load_checkpoint(path)
+        # geometry gate FIRST: a wrong-D/wrong-K slot must die with the
+        # typed, actionable error before any device_put can produce an
+        # opaque reshape traceback.  Under cfg.elastic_resume a D != D'
+        # checkpoint passes and the stage_tree_global calls below restage
+        # the [K, ...] client stacks onto the CURRENT mesh — the client
+        # axis re-shards, replicated vars re-lay out, and the jitted fns
+        # were already built over this mesh (PARITY.md: bitwise when
+        # D' == D, allclose + exact history when D' != D).
+        validate_geometry(meta, devices=self.D,
+                          processes=jax.process_count(), K=self.cfg.K,
+                          elastic=self.cfg.elastic_resume)
         csh = client_sharding(self.mesh)
         rsh = replicated_sharding(self.mesh)
         put_c = lambda t: stage_tree_global(t, csh)
@@ -1559,6 +1694,16 @@ class BlockwiseFederatedTrainer:
                 self._async_arrival = np.full(self.cfg.K, -1, np.int64)
                 self._async_birth = np.zeros(self.cfg.K, np.int64)
                 self._async_rejected = 0
+        if self.faults.churn_enabled:
+            if "members" in meta:
+                self._members = np.asarray(meta["members"], bool)
+                self._members_joined = int(meta.get("members_joined", 0))
+                self._members_left = int(meta.get("members_left", 0))
+            else:           # checkpoint predates churn: full roster
+                self._members = np.ones(self.cfg.K, bool)
+                self._members_joined = 0
+                self._members_left = 0
+            self._rejoined_mask = np.zeros(self.cfg.K, bool)
         # a pending prefetched epoch stays valid across restore IF its
         # counter matches (epochs are pure functions of the counter);
         # _stage_epoch's counter check handles both cases
@@ -1849,6 +1994,7 @@ class BlockwiseFederatedTrainer:
 
         from federated_pytorch_test_tpu.utils.checkpoint import (
             CheckpointCorruptError,
+            CheckpointGeometryError,
             checkpoint_slots,
             verify_checkpoint,
         )
@@ -1871,6 +2017,11 @@ class BlockwiseFederatedTrainer:
                 # to a manual one.
                 self._check_restored_finite(restored)
                 state, r_blockvars, resume_at, history = restored
+            except CheckpointGeometryError:
+                # every slot was written on the same geometry — falling
+                # back cannot fix a mesh mismatch and would only bury
+                # the actionable message under a corrupt-slot error
+                raise
             except Exception as e:           # corrupt/truncated slot:
                 failures.append(f"{slot}: {e}")     # fall back, don't die
                 log(f"WARNING: checkpoint slot {slot} is unusable ({e}); "
@@ -1884,6 +2035,13 @@ class BlockwiseFederatedTrainer:
                 raise CheckpointCorruptError(
                     "no valid mid-run checkpoint slot survives: "
                     + "; ".join(failures))
+
+        # one-shot preemption arming: the preempt= draw is deterministic
+        # in the round coordinates, so a RESUMED segment replaying the
+        # failing round must not re-fire — the simulated slice was
+        # already lost once, and the supervisor's restart is the
+        # surviving mesh carrying on
+        self._preempt_armed = resume_at is None
 
         if cfg.async_checkpoint and checkpoint_path is not None:
             # created AFTER the resume restore (nothing may be in flight
@@ -1970,9 +2128,19 @@ class BlockwiseFederatedTrainer:
                     with round_trace(len(history),
                                      enabled=cfg.profile_dir is not None):
                         t_round = time.perf_counter()
+                        self._maybe_preempt(nloop, ci, nadmm,
+                                            len(history), checkpoint_path)
                         active, comm_active, corrupt, comm_host, fcounts = \
                             self._round_activity(nloop, ci, nadmm)
                         n_comm = fcounts.pop("n_comm", 1)
+                        if (self.faults.churn_enabled
+                                and self._rejoined_mask.any()
+                                and jax.tree.leaves(state.comp)):
+                            # rejoining clients are NEW clients: their
+                            # stale EF residual / compressor PRNG rows
+                            # reset to block-init values
+                            state = state._replace(comp=self._reset_comp_rows(
+                                state.comp, ci, self._rejoined_mask))
                         q_start = (int(np.sum(self._quarantine > 0))
                                    if cfg.update_guard else 0)
                         loss_acc = None       # on-device [K] accumulator: the

@@ -31,7 +31,7 @@ Spec grammar (``--fault-spec``)::
 
     none
     drop=P,straggle=P,corrupt=P,mode=M,scale=X,seed=N,clients=i+j+k,
-    delay=P,delay_max=N
+    delay=P,delay_max=N,join=P,leave=P,preempt=P
 
 ``P`` are independent per-client per-round probabilities; ``mode`` is
 one of ``nan | inf | signflip | scale | innerprod | collude`` (default
@@ -58,6 +58,25 @@ Delays only matter under ``--async-rounds`` (the synchronous barrier
 waits for everyone, so delay is inert there); unlike the failure
 families they are NOT restricted by ``clients=`` — latency is a
 property of the network, not of the adversary.
+
+``join=P,leave=P`` is the CHURN family (elastic federation): per round,
+each departed client rejoins with probability ``join`` and each live
+client departs with probability ``leave``.  Unlike ``drop`` (a one-round
+outage), churn is a persistent membership change: the engine's ledger
+retires a departed client's EF/quarantine/async state and re-initializes
+it on rejoin.  The draw (tag ``67``) is a pure function of the round
+coordinates, so the SAME ledger trajectory replays across fresh runs and
+mid-run resumes; at least one member always survives (the lowest-indexed
+live client is never evicted — an empty federation has no aggregate).
+Not restricted by ``clients=`` — membership is a property of the fleet.
+
+``preempt=P`` simulates the dominant real-world TPU failure mode: with
+probability ``P`` per round (tag ``71``) the process "loses its slice"
+mid-round — the engine raises :class:`~..parallel.mesh.
+CollectiveTimeoutError` after the newest checkpoint is durable, and the
+restart supervisor's reshape rung resumes on the surviving mesh.
+One-shot semantics: the engine disarms simulated preemption on resumed
+segments, so a deterministic draw cannot re-fire forever.
 """
 
 from __future__ import annotations
@@ -93,11 +112,19 @@ class FaultSpec:
     clients: Optional[Tuple[int, ...]] = None   # None = every client eligible
     delay: float = 0.0          # per-round in-transit continuation probability
     delay_max: int = 8          # staleness cap on any single delivery
+    join: float = 0.0           # per-round rejoin probability (churn)
+    leave: float = 0.0          # per-round departure probability (churn)
+    preempt: float = 0.0        # per-round simulated slice-preemption prob.
 
     @property
     def enabled(self) -> bool:
         return (self.drop > 0 or self.straggle > 0 or self.corrupt > 0
-                or self.delay > 0)
+                or self.delay > 0 or self.churn_enabled or self.preempt > 0)
+
+    @property
+    def churn_enabled(self) -> bool:
+        """Does this spec ever change the membership ledger?"""
+        return self.join > 0 or self.leave > 0
 
     @property
     def masking(self) -> bool:
@@ -137,6 +164,11 @@ class FaultSpec:
                         f"fault-spec delay={p} outside [0, 1) (a continuation "
                         "probability of 1 would never deliver)")
                 kw[key] = p
+            elif key in ("join", "leave", "preempt"):
+                p = float(val)
+                if not 0.0 <= p <= 1.0:
+                    raise ValueError(f"fault-spec {key}={p} outside [0, 1]")
+                kw[key] = p
             elif key == "delay_max":
                 n = int(val)
                 if n < 0:
@@ -164,7 +196,8 @@ class FaultSpec:
         if not out.enabled:
             raise ValueError(
                 f"fault-spec {spec!r} names no fault probability "
-                "(set drop/straggle/corrupt/delay, or pass 'none')")
+                "(set drop/straggle/corrupt/delay/join/leave/preempt, "
+                "or pass 'none')")
         return out
 
     def round_faults(self, K: int, nloop: int, ci: int, nadmm: int
@@ -218,6 +251,43 @@ class FaultSpec:
                          / np.log(np.maximum(p, 1e-300)))
         d = np.where(p > 0.0, d, 0.0)
         return np.clip(d, 0, self.delay_max).astype(np.int64)
+
+    def round_churn(self, members: np.ndarray, nloop: int, ci: int,
+                    nadmm: int) -> np.ndarray:
+        """Advance the [K] bool membership ledger by one round.
+
+        A pure function of ``(seed, round coordinates, members)`` — the
+        ledger itself carries the history, so replaying the rounds from
+        any checkpointed ledger reproduces the identical trajectory (tag
+        ``67`` keeps the stream disjoint from every other family).  The
+        lowest-indexed live member is immune to eviction: the federation
+        never goes empty.
+        """
+        if not self.churn_enabled:
+            return members
+        members = np.asarray(members, bool)
+        K = members.shape[0]
+        u = np.random.default_rng(
+            [self.seed, 67, nloop, ci, nadmm]).random((2, K))
+        joined = ~members & (u[0] < self.join)
+        left = members & (u[1] < self.leave)
+        anchor = int(np.argmax(members)) if members.any() else 0
+        left[anchor] = False
+        return (members | joined) & ~left
+
+    def round_preempt(self, nloop: int, ci: int, nadmm: int) -> bool:
+        """Does round ``(nloop, ci, nadmm)`` simulate a slice preemption?
+
+        Single seeded draw (tag ``71``), stateless in the round
+        coordinates like every other family.  The ENGINE makes this
+        one-shot (disarmed on resumed segments); the draw itself is
+        deterministic so the chaos tests can predict the failing round.
+        """
+        if self.preempt <= 0.0:
+            return False
+        u = np.random.default_rng(
+            [self.seed, 71, nloop, ci, nadmm]).random()
+        return bool(u < self.preempt)
 
 
 def apply_corruption(delta: jnp.ndarray, corrupt: jnp.ndarray, mode: str,

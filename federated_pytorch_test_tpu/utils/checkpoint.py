@@ -46,6 +46,66 @@ class NoUsableCheckpointError(FileNotFoundError):
     with a secondary exception that masks the original alert."""
 
 
+class CheckpointGeometryError(ValueError):
+    """The checkpoint's stamped mesh geometry is incompatible with the
+    mesh trying to resume it.  Raised by :func:`validate_geometry` on
+    EVERY resume path — before this, a wrong-D resume died with an
+    opaque reshape traceback deep inside jax.  Deliberately NOT retried
+    by the slot-fallback walk: an older slot was written on the same
+    geometry, so falling back cannot fix it and would only mask the
+    actionable message."""
+
+
+def mesh_geometry_meta(*, devices: int, processes: int, K: int,
+                       members=None) -> Dict[str, Any]:
+    """Mesh/roster geometry keys for checkpoint ``meta``.
+
+    Values are 0-d int64 / bool arrays so :func:`save_checkpoint`'s
+    ``np.asarray`` and :func:`load_checkpoint`'s 0-d ``.item()`` round
+    them through orbax as plain python ints on load.  ``members`` (the
+    churn ledger, shape ``[K]`` bool) rides along when given.
+    """
+    geom: Dict[str, Any] = {
+        "geom_devices": np.int64(devices),
+        "geom_processes": np.int64(processes),
+        "geom_K": np.int64(K),
+    }
+    if members is not None:
+        geom["members"] = np.asarray(members, bool)
+    return geom
+
+
+def validate_geometry(meta: Dict[str, Any], *, devices: int, processes: int,
+                      K: int, elastic: bool = False) -> None:
+    """Check a checkpoint's stamped geometry against the live mesh.
+
+    Pre-geometry checkpoints (no ``geom_*`` keys) pass unchecked — they
+    stay loadable exactly as before.  ``geom_K`` must always match: the
+    client stack's leading axis is baked into every saved array, so a
+    different K is never resumable.  A device-count change is legal only
+    under ``elastic`` (mesh-reshaping resume): the client axis restages
+    onto the new mesh as long as ``K %% D'`` == 0 (the engines enforce
+    divisibility at construction).  Raises
+    :class:`CheckpointGeometryError` with an actionable message.
+    """
+    if "geom_devices" not in meta:
+        return
+    ck_d = int(meta["geom_devices"])
+    ck_k = int(meta["geom_K"])
+    if ck_k != K:
+        raise CheckpointGeometryError(
+            f"checkpoint was written with K={ck_k} clients but this run "
+            f"has K={K}: the client stack's leading axis is saved per "
+            "client, so K can never change across a resume")
+    if ck_d != devices and not elastic:
+        raise CheckpointGeometryError(
+            f"checkpoint was written on a {ck_d}-device mesh but this "
+            f"run has {devices} devices; pass --elastic-resume "
+            "(cfg.elastic_resume=True) to restage the client axis onto "
+            "the new mesh, or resume on the original device count for "
+            "bitwise continuation")
+
+
 def _abspath(path: str) -> str:
     return os.path.abspath(os.path.expanduser(path))
 
@@ -173,11 +233,14 @@ def _is_primary() -> bool:
 
 def _barrier(tag: str) -> None:
     """Cross-process sync so only process 0 performs slot filesystem
-    surgery while peers wait (no-op single-process)."""
+    surgery while peers wait (no-op single-process).  Routed through the
+    bounded-wait layer so a peer lost to preemption surfaces as a typed
+    CollectiveTimeoutError instead of wedging the checkpoint forever
+    (inert at the default timeout 0)."""
     if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+        from ..parallel.mesh import sync_global
 
-        multihost_utils.sync_global_devices(tag)
+        sync_global(tag)
 
 
 def _promote_and_sweep(path: str) -> None:
@@ -365,9 +428,22 @@ def load_checkpoint(path: str, like=None) -> Tuple[Any, Dict[str, Any]]:
     ``like`` (optional): a pytree with the target shardings; restored arrays
     are ``device_put`` onto them (e.g. back onto the client mesh axis).
     Returns ``(state, meta)``.
+
+    The plain restore re-creates arrays on the devices recorded in the
+    checkpoint's sharding file (what the multi-host non-addressable
+    restore needs).  When that topology no longer exists — an elastic
+    resume onto a smaller or larger mesh — orbax refuses; the fallback
+    restores every leaf host-side (numpy, bit-identical values) and the
+    caller restages onto the live mesh (``stage_tree_global``).
     """
     ckptr = ocp.PyTreeCheckpointer()
-    restored = ckptr.restore(_abspath(path))
+    try:
+        restored = ckptr.restore(_abspath(path))
+    except (ValueError, RuntimeError):
+        structure = ckptr.metadata(_abspath(path))
+        args = jax.tree.map(
+            lambda _: ocp.RestoreArgs(restore_type=np.ndarray), structure)
+        restored = ckptr.restore(_abspath(path), restore_args=args)
     state, meta = restored["state"], restored.get("meta", {})
     meta = {k: v.item() if getattr(v, "ndim", 1) == 0 else v
             for k, v in meta.items()}

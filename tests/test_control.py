@@ -714,6 +714,79 @@ class TestChaosAcceptance:
                 f.write(line + "\n")
         assert replay_main([dropped]) == 1
 
+    def test_elastic_preemption_reshapes_and_survives(self, tmp_path):
+        # elastic-federation acceptance: a seeded preempt= fault hangs a
+        # collective mid-run (CollectiveTimeoutError), the supervisor's
+        # reshape rung resumes the newest checkpoint onto the surviving
+        # 4-device mesh, the run completes, and control.replay verifies
+        # the reshape record against the segment headers — exit 1 once
+        # the record is tampered with or dropped
+        data8 = FederatedCifar10(K=8, batch=16, limit_per_client=32,
+                                 limit_test=32)
+        cfg = FederatedConfig(
+            K=8, Nloop=1, Nepoch=1, Nadmm=3, default_batch=16,
+            check_results=False, admm_rho0=0.1, num_devices=8,
+            fault_spec="preempt=1,seed=3", elastic_resume=True,
+            max_restarts=2, restart_backoff=0.0,
+            obs_sinks="jsonl,memory", obs_dir=str(tmp_path / "obs"))
+        built = []
+
+        def build(c, attempt):
+            t = BlockwiseFederatedTrainer(TinyNet(), c, data8,
+                                          AdmmConsensus())
+            t.L = 1
+            t.obs_run_name = "elastic"
+            built.append((attempt, c.num_devices))
+            return t
+
+        state, hist = supervise_classifier(
+            build, cfg, str(tmp_path / "ck"),
+            run_kwargs={"log": lambda m: None},
+            log=lambda m: None, sleep=lambda s: None)
+        # the run completed despite losing half the mesh at round 1
+        assert len(hist) == cfg.Nadmm
+        # attempt 1 ran on the full mesh; the restart rebuilt on the
+        # surviving divisor of K (8 -> 4); preemption is one-shot, so
+        # the resumed segment ran to completion
+        assert built[0] == (1, 8)
+        assert built[1] == (2, 4)
+        assert len(built) == 2
+
+        path = str(tmp_path / "obs" / "elastic.jsonl")
+        recs = read_records(path, validate=True)
+        reshapes = [r for r in recs if r["event"] == "control"
+                    and r["intervention"] == "reshape"]
+        assert len(reshapes) == 1
+        r = reshapes[0]
+        assert (r["from_value"], r["to_value"]) == (8, 4)
+        assert r["source"] == "supervisor" and r["scope"] == "restart"
+        # the resumed segment's header advertises the reshaped mesh
+        headers = [x for x in recs if x["event"] == "run_header"]
+        assert [h["mesh_shape"]["clients"] for h in headers] == [8, 4]
+
+        # replay: exit 0 on the honest stream
+        assert replay_main([path]) == 0
+        lines = open(path).read().splitlines()
+        # tampered reshape target -> exit 1
+        tampered = str(tmp_path / "tampered.jsonl")
+        out = []
+        for line in lines:
+            rec = json.loads(line)
+            if rec.get("intervention") == "reshape":
+                rec["to_value"] = 2
+            out.append(json.dumps(rec))
+        with open(tampered, "w") as f:
+            f.write("\n".join(out) + "\n")
+        assert replay_main([tampered]) == 1
+        # dropped reshape record -> exit 1 (the mesh changed between
+        # segments with no decision on the stream)
+        dropped = str(tmp_path / "dropped.jsonl")
+        with open(dropped, "w") as f:
+            for line in lines:
+                if json.loads(line).get("intervention") != "reshape":
+                    f.write(line + "\n")
+        assert replay_main([dropped]) == 1
+
     def test_errors_list_names_divergence(self, tmp_path):
         # replay() (the library face of the CLI) reports structured
         # messages — spot-check one so the CLI text stays meaningful

@@ -156,6 +156,68 @@ class TestFaultSpecParse:
         with pytest.raises(ValueError, match="out of range"):
             spec.round_faults(4, 0, 0, 0)
 
+    def test_churn_and_preempt_grammar(self):
+        spec = FaultSpec.parse("join=0.2,leave=0.3,preempt=0.1,seed=3")
+        assert spec.join == 0.2 and spec.leave == 0.3
+        assert spec.preempt == 0.1
+        assert spec.enabled and spec.churn_enabled and not spec.masking
+
+    @pytest.mark.parametrize("bad", [
+        "join=1.5",                    # probability out of range
+        "leave=-0.1",
+        "preempt=2",
+    ])
+    def test_churn_rejects(self, bad):
+        with pytest.raises(ValueError):
+            FaultSpec.parse(bad)
+
+
+class TestChurnSchedule:
+    def test_same_seed_same_ledger(self):
+        a = FaultSpec.parse("join=0.4,leave=0.4,seed=11")
+        b = FaultSpec.parse("join=0.4,leave=0.4,seed=11")
+        ma = mb = np.ones(8, bool)
+        for r in range(6):
+            ma = a.round_churn(ma, 0, 0, r)
+            mb = b.round_churn(mb, 0, 0, r)
+            np.testing.assert_array_equal(ma, mb)
+
+    def test_at_least_one_member_survives(self):
+        # leave=1 empties the roster except the anchor (lowest-indexed
+        # live client), which is immune by construction
+        spec = FaultSpec.parse("leave=1,seed=0")
+        m = np.ones(4, bool)
+        for r in range(4):
+            m = spec.round_churn(m, 0, 0, r)
+            assert m.sum() >= 1
+        np.testing.assert_array_equal(m, [True, False, False, False])
+
+    def test_join_readmits_departed_clients(self):
+        spec = FaultSpec.parse("join=1,seed=0")
+        m = np.asarray([True, False, False, False])
+        m = spec.round_churn(m, 0, 0, 0)
+        assert m.all()
+
+    def test_disabled_churn_is_identity(self):
+        spec = FaultSpec.parse("drop=0.5,seed=1")
+        m = np.asarray([True, False, True, False])
+        out = spec.round_churn(m, 0, 0, 0)
+        np.testing.assert_array_equal(out, m)
+
+    def test_preempt_draw_deterministic(self):
+        a = FaultSpec.parse("preempt=0.5,seed=9")
+        b = FaultSpec.parse("preempt=0.5,seed=9")
+        draws_a = [a.round_preempt(n, 0, r)
+                   for n in range(3) for r in range(4)]
+        draws_b = [b.round_preempt(n, 0, r)
+                   for n in range(3) for r in range(4)]
+        assert draws_a == draws_b
+        assert any(draws_a) and not all(draws_a)
+
+    def test_preempt_disabled_never_fires(self):
+        spec = FaultSpec.parse("drop=0.5,seed=1")
+        assert not any(spec.round_preempt(0, 0, r) for r in range(8))
+
 
 class TestFaultSchedule:
     def test_same_seed_bit_identical(self):
