@@ -413,14 +413,19 @@ def _bench_round(trainer, ci, *, reps, with_comm=False, with_staging=False,
         state, losses = train_epoch(state, y, trainer.client_norm, ks,
                                     bx, by, bw, z, rho,
                                     trainer._ones_mask)
-        diag = None
+        diag, extras = None, ()
         if with_comm:
-            state, z, y, rho, x0, yhat0, diag = comm_fns["plain"](
+            # the comm fn's output is variadic past the base 7-tuple
+            # (client-ledger probes, guard verdicts); keep the tail so
+            # the last rep's per-client norms can land in the artifact
+            outs = comm_fns["plain"](
                 state, z, y, rho, x0, yhat0, trainer._ones_mask,
                 trainer._zero_corrupt, trainer._inf_bound)
-        return state, z, y, rho, x0, yhat0, losses, diag
+            state, z, y, rho, x0, yhat0, diag = outs[:7]
+            extras = outs[7:]
+        return state, z, y, rho, x0, yhat0, losses, diag, extras
 
-    def sync(losses, diag):
+    def sync(losses, diag, extras=()):
         # NOTE: under the axon relay block_until_ready does not
         # actually block; force a host fetch of values that depend on
         # the full computation instead.
@@ -470,7 +475,55 @@ def _bench_round(trainer, ci, *, reps, with_comm=False, with_staging=False,
         fields["bytes_on_wire"] = reps * trainer.round_bytes_on_wire(N, K)
         fields["bytes_dense"] = reps * 4 * N * K
     rec = _obs_emit_round(**fields)
+    _emit_client_grain(trainer, rec, carry[8], N, K, with_comm)
     return record_ips(rec, trainer.D)
+
+
+#: per-client aggregates from the most recent comm-bearing timed region
+#: (cleared on each _bench_round) — _measure publishes them into the
+#: artifact so the bench.jsonl client record and the JSON summary agree
+_LAST_CLIENT_AGG: dict = {}
+
+
+def _emit_client_grain(trainer, rec, extras, N, K, with_comm) -> None:
+    """Land the last rep's client-ledger probe outputs as a ``client``
+    record next to the bench round record, plus host-side aggregates
+    (norm skew, bytes per client) for the artifact summary."""
+    _LAST_CLIENT_AGG.clear()
+    if not (with_comm and getattr(trainer, "_client_probe", False)
+            and len(extras) >= 2):
+        return
+    try:
+        cl_nrm = np.asarray(extras[0], np.float64)
+        cl_dist = np.asarray(extras[1], np.float64)
+        bytes_per_client = int(trainer.round_bytes_on_wire(N, 1))
+        finite = cl_nrm[np.isfinite(cl_nrm)]
+        med = float(np.median(finite)) if finite.size else 0.0
+        agg = {
+            "client_norm_max": round(float(finite.max()), 6)
+            if finite.size else None,
+            "client_norm_median": round(med, 6) if finite.size else None,
+            # max/median spread of per-client update norms: ~1 means the
+            # synthetic shards pull evenly; a big skew means one client
+            # dominates the consensus step
+            "client_norm_skew": round(float(finite.max()) / med, 4)
+            if finite.size and med > 0 else None,
+            "client_bytes": bytes_per_client,
+            "clients": int(K),
+        }
+        _LAST_CLIENT_AGG.update(agg)
+        obs = _BENCH_OBS
+        if obs is not None and obs.enabled:
+            from federated_pytorch_test_tpu.obs.clients import (
+                client_round_fields,
+            )
+            obs.client_event(client_round_fields(
+                int(rec.get("round_index", 0)), int(K),
+                update_norm=cl_nrm, dist_z=cl_dist,
+                payload_bytes=bytes_per_client))
+    except Exception as e:      # noqa: BLE001 — telemetry is best-effort
+        print(f"bench: client-grain emit failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
 
 
 def _measure(out: dict, progress=lambda: None) -> None:
@@ -552,6 +605,12 @@ def _measure(out: dict, progress=lambda: None) -> None:
         "members_joined": int(trainer._members_joined),
         "members_left": int(trainer._members_left),
     }
+    # client-grain summary of the headline round (the comm-bearing timed
+    # region): norm dispersion across the K shards + bytes each client
+    # ships per round; the per-client vectors are in bench.jsonl as a
+    # ``client`` record (see obs/clients.py)
+    if _LAST_CLIENT_AGG:
+        out["client_grain"] = dict(_LAST_CLIENT_AGG)
     progress()
 
     # full-net epoch (the no_consensus driver's path): every parameter

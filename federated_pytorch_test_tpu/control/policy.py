@@ -62,6 +62,11 @@ _PRESETS = {
 SCOPE_ROUND = "round"      # engine, next round (host-read knob)
 SCOPE_BLOCK = "block"      # engine, next block boundary (recompile)
 SCOPE_RESTART = "restart"  # supervisor, next run segment (reconstruct)
+SCOPE_ADVISORY = "advisory"  # nobody: recorded evidence only — by
+#                              construction Controller._register never
+#                              queues this scope, so client-health
+#                              signals can extend the replay contract
+#                              without adding interventions
 
 
 class ControlRestart(RuntimeError):
@@ -252,6 +257,8 @@ class ControlPolicy:
             return self._observe_alert(rec)
         if ev == "round":
             return self._observe_round(rec)
+        if ev == "client":
+            return self._observe_client(rec)
         return []
 
     def _observe_alert(self, alert: Dict[str, Any]) -> List[Decision]:
@@ -305,6 +312,45 @@ class ControlPolicy:
                 "minibatch", observed=obs, threshold=thr, streak=stk)
             if d:
                 self.cur_batch = new
+                out.append(d)
+        return out
+
+    def _observe_client(self, rec: Dict[str, Any]) -> List[Decision]:
+        """Client-health evidence from a schema-v10 ``client`` record
+        (obs/clients.py) — observe-only: the one rule here fires an
+        SCOPE_ADVISORY decision, which ``Controller._register`` never
+        queues, so client records extend the replay contract without
+        adding interventions.  Same hysteresis plumbing as every other
+        rule, so replay from a recorded stream reproduces the exact
+        decision sequence."""
+        ridx = rec.get("round_index")
+        if not isinstance(ridx, int):
+            return []
+        norms = rec.get("update_norm")
+        guard = rec.get("guard_ok")
+        active = rec.get("active")
+        k = rec.get("clients")
+        if not isinstance(norms, list) or not isinstance(k, int):
+            return []
+        offenders = set()
+        for i, v in enumerate(norms[:k]):
+            if isinstance(v, (int, float)) and not math.isfinite(v):
+                offenders.add(i)
+        if isinstance(guard, list) and isinstance(active, list):
+            for i, (g, a) in enumerate(zip(guard[:k], active[:k])):
+                if _finite(g) and _finite(a) and a > 0 and g < 0.5:
+                    offenders.add(i)
+        n = self._bump("client_sick", bool(offenders))
+        out: List[Decision] = []
+        if n >= self.streak and offenders:
+            d = self._decide(
+                ridx, "flag_clients", "client_health", None,
+                sorted(offenders), SCOPE_ADVISORY,
+                f"per-client evidence: {len(offenders)} client(s) with "
+                f"non-finite update norms or guard rejections for {n} "
+                "consecutive rounds",
+                observed=float(len(offenders)), threshold=0.0, streak=n)
+            if d:
                 out.append(d)
         return out
 

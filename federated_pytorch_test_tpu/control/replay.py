@@ -84,7 +84,9 @@ def derive_segment_decisions(segment: List[Dict[str, Any]]
     ctl = Controller(ControlPolicy.from_config(config), mode=mode,
                      can_restart=True)
     for rec in segment:
-        if rec.get("event") in ("round", "alert"):
+        # client records are policy input too (schema v10 advisory
+        # client-health rule) — file order IS the in-process feed order
+        if rec.get("event") in ("round", "alert", "client"):
             ctl.observe(rec)
     return ctl.records
 

@@ -10,12 +10,21 @@
 - :mod:`.compare`  — cross-run regression CLI (CI gate).
 - :mod:`.costs`    — per-jit-site compile/HLO device-cost ledger.
 - :mod:`.profile`  — ``python -m federated_pytorch_test_tpu.obs.profile``.
+- :mod:`.clients`  — client-grain flight recorder: per-client ledgers,
+  deterministic anomaly ranking, cohort rollups
+  (``python -m federated_pytorch_test_tpu.obs.clients``).
 
 See README "Observability" for the artifact format and how XProf traces
 (``--profile-dir`` + per-round ``StepTraceAnnotation``) correlate with
 the JSONL timeline.
 """
 
+from federated_pytorch_test_tpu.obs.clients import (  # noqa: F401
+    ClientLedger,
+    client_round_fields,
+    ledger_from_records,
+    summarize_clients,
+)
 from federated_pytorch_test_tpu.obs.costs import (  # noqa: F401
     CostLedger,
     round_cost_fields,

@@ -78,6 +78,35 @@ class CompareError(ValueError):
     """Unusable input (unknown shape, unreadable file)."""
 
 
+def expand_candidates(paths: List[str]) -> List[str]:
+    """Resolve the candidate set: each argument may be a file, a
+    directory (all ``*.jsonl`` run streams plus ``bench*.json``
+    artifacts directly inside it), or a glob pattern.  Expansion is
+    sorted per argument — deterministic ordering, so the bench matrix
+    and chaos-test artifact directories gate identically across CI
+    runs.  A directory/glob that matches nothing is an error (a silent
+    empty candidate set would vacuously pass the gate)."""
+    import glob as globlib
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            hits = sorted(globlib.glob(os.path.join(p, "*.jsonl"))) + \
+                sorted(globlib.glob(os.path.join(p, "bench*.json")))
+            if not hits:
+                raise CompareError(
+                    f"{p}: directory holds no *.jsonl or bench*.json "
+                    "artifacts")
+            out.extend(hits)
+        elif any(ch in p for ch in "*?["):
+            hits = sorted(globlib.glob(p))
+            if not hits:
+                raise CompareError(f"{p}: glob matched no files")
+            out.extend(hits)
+        else:
+            out.append(p)
+    return out
+
+
 def _num(v) -> Optional[float]:
     if isinstance(v, (int, float)) and not isinstance(v, bool):
         return float(v)
@@ -123,6 +152,22 @@ def load_source(path: str) -> Dict[str, Any]:
             src["notes"].append(
                 f"{s['reshapes']} mesh reshape(s): segments ran on "
                 "different device counts; wall-clock metrics span both")
+        # client-grain dispersion (schema v10, obs/clients.py): info-
+        # direction rows — per-client norm skew and the anomaly-ranking
+        # top offender, so "is the same client the outlier in both
+        # runs?" is answerable from the diff without gating on it
+        for k in ("client_norm_skew", "client_norm_max",
+                  "client_norm_median", "top_offender",
+                  "top_offender_score"):
+            v = _num(s.get(k))
+            if v is not None:
+                src["metrics"][k] = v
+        if s.get("top_offender") is not None:
+            src["notes"].append(
+                f"client ledger: top offender c{s['top_offender']} "
+                f"(score {s.get('top_offender_score', 0.0):.3f}) over "
+                f"{s.get('client_records')} client record(s) — compare "
+                "across runs for offender stability")
         # device-cost metrics (schema v6): present only when the run's
         # ledger emitted them, so pre-v6 streams compare unchanged
         for k, val in profile_metrics(records).items():
@@ -283,7 +328,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "on regression (CI gate)")
     p.add_argument("paths", nargs="+",
                    help="candidate artifacts (run .jsonl, bench .json, "
-                        "BENCH_rNN.json)")
+                        "BENCH_rNN.json), or a directory / glob of them "
+                        "(expanded sorted, so the candidate order is "
+                        "deterministic)")
     p.add_argument("--baseline", help="baseline artifact; defaults to the "
                    "single candidate's embedded baseline_ref")
     p.add_argument("--threshold", type=float, default=5.0,
@@ -292,7 +339,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="emit the comparison as JSON instead of markdown")
     args = p.parse_args(argv)
     try:
-        candidates = [load_source(pth) for pth in args.paths]
+        cand_paths = expand_candidates(args.paths)
+        candidates = [load_source(pth) for pth in cand_paths]
         base_path = args.baseline
         if base_path is None:
             refs = [c["baseline_ref"] for c in candidates
@@ -300,7 +348,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             if len(candidates) == 1 and refs:
                 ref = refs[0]
                 if not os.path.exists(ref):   # refs are repo-root relative
-                    rel = os.path.join(os.path.dirname(args.paths[0]) or ".",
+                    rel = os.path.join(os.path.dirname(cand_paths[0]) or ".",
                                        ref)
                     ref = rel if os.path.exists(rel) else ref
                 base_path = ref
@@ -363,6 +411,17 @@ def selftest() -> None:
         assert rc == 0, f"unmeasured artifact must not fake a regression"
         src = load_source(unmeasured)
         assert not src["metrics"] and src["notes"], src
+        # directory / glob candidate expansion, deterministic ordering
+        hits = expand_candidates([os.path.join(d, "*.json")])
+        assert hits == sorted([base, regressed, same, unmeasured]), hits
+        rc = run([os.path.join(d, "same.js*"), "--baseline", base])
+        assert rc == 0, f"glob candidate must exit 0, got {rc}"
+        try:
+            expand_candidates([os.path.join(d, "no_such_*")])
+        except CompareError:
+            pass
+        else:
+            raise AssertionError("empty glob must raise (vacuous gate)")
 
 
 if __name__ == "__main__":

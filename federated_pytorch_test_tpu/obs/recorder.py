@@ -363,6 +363,27 @@ class RunRecorder:
         rec.update(json_safe(fields))
         return self._emit(rec)
 
+    def client_event(self, fields: Dict[str, Any]) -> Optional[dict]:
+        """Emit one ``client`` record (schema v10; obs/clients.py).
+
+        ``fields`` is a :func:`~..obs.clients.client_round_fields` body:
+        ``round_index`` + ``clients`` plus the advisory length-K lists.
+        Emitted right after the round record it describes, so file
+        order equals replay order.  Like alerts, the record is policy
+        input: it is fed to the controller (json_safe first, so replay
+        from a parsed file sees bit-identical values) whether or not a
+        sink writes it.  Deliberately NO ``time_unix`` — the ledger and
+        its anomaly ranking are pure functions of the stream.
+        """
+        rec = {"event": "client", "schema": SCHEMA_VERSION,
+               "run_id": self.run_id}
+        rec.update(json_safe(fields))
+        if self.control is not None:
+            self.control.observe(rec)
+        if not self.enabled:
+            return None
+        return self._emit(rec)
+
     def compile_event(self, fields: Dict[str, Any], *,
                       parent_span: Optional[str] = None) -> Optional[dict]:
         """Emit one ``compile`` record (schema v6; obs/costs.py).

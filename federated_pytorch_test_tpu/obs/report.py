@@ -143,6 +143,13 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     out["members_min"] = min(members) if members else None
     out["joined_total"] = tot("joined")
     out["left_total"] = tot("left")
+    # client-grain dispersion (schema v10, obs/clients.py): max/median
+    # per-client mean update norm, their skew, and the anomaly-ranking
+    # top offender.  All absent-keys-stay-absent on pre-v10 streams
+    # (summarize_clients returns {} with no client records), so v9
+    # summaries are unchanged.
+    from federated_pytorch_test_tpu.obs.clients import summarize_clients
+    out.update(summarize_clients(records))
     # watchdog alerts (schema v5)
     alerts = [r for r in records if r.get("event") == "alert"]
     out["alerts"] = len(alerts)
@@ -251,6 +258,17 @@ def format_report(s: Dict[str, Any]) -> str:
             f"joined={s.get('joined_total') or 0} "
             f"left={s.get('left_total') or 0} "
             f"reshapes={s.get('reshapes') or 0}")
+    if s.get("client_records"):
+        msg = (f"{s['client_records']} record(s), "
+               f"K={s.get('clients_observed')}, "
+               f"top_offender=c{s.get('top_offender')} "
+               f"(score {s.get('top_offender_score', 0.0):.3f})")
+        if s.get("client_norm_skew") is not None:
+            msg += (f", norm max/median="
+                    f"{s['client_norm_max']:.4g}/"
+                    f"{s['client_norm_median']:.4g} "
+                    f"(skew {s['client_norm_skew']:.2f})")
+        row("client ledger", msg)
     if s.get("alerts"):
         row("health alerts",
             f"{s['alerts']} alert(s): {', '.join(s.get('alert_rules') or [])}")
@@ -335,19 +353,23 @@ def selftest() -> str:
     assert record_ips({"images": 0, "round_seconds": 0}) == 0.0
 
     from federated_pytorch_test_tpu.control import replay as control_replay
-    from federated_pytorch_test_tpu.obs import compare, health, profile, trace
+    from federated_pytorch_test_tpu.obs import (
+        clients, compare, health, profile, trace,
+    )
 
     trace.selftest()
     health.selftest()
     compare.selftest()
     profile.selftest()
     control_replay.selftest()
+    clients.selftest()
     return (table
             + "\nobs trace selftest: OK (Chrome trace valid)"
             + "\nobs health selftest: OK (NaN streak alerted)"
             + "\nobs compare selftest: OK (regression gate works)"
             + "\nobs profile selftest: OK (cost attribution reconstructs)"
             + "\ncontrol replay selftest: OK (decisions reproduce)"
+            + "\nobs clients selftest: OK (anomaly ranking replayable)"
             + "\nobs report selftest: OK")
 
 

@@ -1,6 +1,6 @@
 """Versioned record schema for run telemetry.
 
-One run = one JSONL stream of seven event kinds:
+One run = one JSONL stream of eight event kinds:
 
 - ``run_header``  — emitted once when a run (or resumed segment) opens:
   config snapshot, mesh shape, jax/backend versions, git rev.
@@ -26,6 +26,13 @@ One run = one JSONL stream of seven event kinds:
   scope, whether it was applied, and the telemetry that justified it.
   Pure function of the recorded stream (no wall clock): replay with
   ``python -m federated_pytorch_test_tpu.control.replay``.
+- ``client``      — one per communication round (schema v10;
+  ``obs/clients.py``): the client-grain flight recorder.  Parallel
+  length-K list fields carry per-client update norms, delta-vs-z
+  distance, loss contribution, guard verdicts and quarantine state,
+  fault tags, async staleness/admission, and membership — the round
+  record's counters, un-aggregated.  Emitted right AFTER the round
+  record it describes, so file order is the replay order.
 
 The schema unifies what ``engine.py``, ``cpc_engine.py`` and
 ``vae_engine.py`` used to build as ad-hoc dicts; every record carries
@@ -106,11 +113,29 @@ from typing import Any, Dict
 # fields (`intervention="reshape"`, param/from_value/to_value/scope/
 # attempt/reason); control.replay cross-checks them against consecutive
 # run_header `mesh_shape` values.
-# v1..v8 records remain valid: validate_record accepts ver <= SCHEMA_VERSION.
-SCHEMA_VERSION = 9
+# v10 (additive): the client-grain flight recorder (obs/clients.py) — a
+# new `client` record kind, at most one per communication round, emitted
+# immediately AFTER the round record it describes (file order == replay
+# order; control.replay feeds both in sequence).  Scalar `clients` is
+# the cohort size K; every other payload field is a parallel length-K
+# list indexed by client id: `update_norm` (||x_k - z|| BEFORE guard
+# neutralisation, so NaN/inf corruption stays visible), `dist_z`
+# (||x_k - z_new|| after the consensus fold), `loss_client`, `weight`
+# (the mean weight incl. participation and staleness decay), `active`,
+# `guard_ok` (guard verdicts, only when --update-guard is on),
+# `quarantine` (rounds remaining), fault tags `dropped`/`straggled`/
+# `corrupted`, async `staleness`/`admitted`, and churn `members`.
+# `payload_bytes` is the per-participant uplink cost of the round.
+# ALL list fields are advisory (absent means "that subsystem was off",
+# never zeroed — PARITY.md); streams with client_ledger=False are
+# byte-identical to v9.  The record is derived from host values the
+# engine already fetched plus one optional probe output, and the
+# anomaly ranking in obs/clients.py is a pure function of the stream.
+# v1..v9 records remain valid: validate_record accepts ver <= SCHEMA_VERSION.
+SCHEMA_VERSION = 10
 
 EVENTS = ("run_header", "round", "summary", "span", "alert", "compile",
-          "control")
+          "control", "client")
 
 
 class SchemaError(ValueError):
@@ -151,8 +176,8 @@ FIELDS: Dict[str, Any] = {
     "pid":          (("run_header",), _INT),
     # round coordinates (spans and alerts are keyed to the same index the
     # XProf round_trace annotations use, so all three timelines correlate)
-    "round_index":  (("round", "span", "alert", "compile", "control"),
-                     _INT),
+    "round_index":  (("round", "span", "alert", "compile", "control",
+                      "client"), _INT),
     "nloop":        (("round",), _INT),
     "block":        (("round",), _INT),
     "nadmm":        (("round",), _INT),
@@ -263,6 +288,24 @@ FIELDS: Dict[str, Any] = {
     "attempt":      (("control",), _INT),     # supervisor: restart count
     "backoff_seconds": (("control",), _NUM),  # supervisor: seeded backoff
     "ladder_stage": (("control",), _INT),     # supervisor: degradation rung
+    # client-grain flight recorder (schema v10; obs/clients.py).  All
+    # list fields are parallel, length `clients`, indexed by client id;
+    # each is advisory — present only when its subsystem ran.
+    "clients":      (("client",), _INT),      # cohort size K
+    "update_norm":  (("client",), _LIST),     # ||x_k - z|| pre-guard
+    "dist_z":       (("client",), _LIST),     # ||x_k - z_new|| post-fold
+    "loss_client":  (("client",), _LIST),
+    "weight":       (("client",), _LIST),     # mean weight (partic+stale)
+    "active":       (("client",), _LIST),     # 0/1 contributed this round
+    "guard_ok":     (("client",), _LIST),     # guard verdicts (guard on)
+    "quarantine":   (("client",), _LIST),     # rounds remaining
+    "dropped":      (("client",), _LIST),     # fault tags this round
+    "straggled":    (("client",), _LIST),
+    "corrupted":    (("client",), _LIST),
+    "staleness":    (("client",), _LIST),     # async: rounds stale
+    "admitted":     (("client",), _LIST),     # async: admission outcome
+    "members":      (("client",), _LIST),     # churn roster after tick
+    "payload_bytes": (("client",), _INT),     # uplink bytes/participant
     # summary totals / rates
     "status":       (("summary",), _STR),
     "rounds":       (("summary",), _INT),
@@ -306,6 +349,7 @@ REQUIRED = {
     "compile": ("event", "schema", "run_id", "site", "compile_seconds"),
     "control": ("event", "schema", "run_id", "round_index", "source",
                 "intervention"),
+    "client": ("event", "schema", "run_id", "round_index", "clients"),
 }
 
 

@@ -94,8 +94,20 @@ def to_chrome_trace(records: List[Dict[str, Any]],
     """Build a Chrome trace-event JSON object from an obs record stream."""
     events: List[Dict[str, Any]] = []
     wall0: Optional[float] = None
+    # supervisor-restart attempt per segment: the dying segment writes
+    # the restart control record (with its 1-based `attempt`), so the
+    # segment that FOLLOWS it is that attempt's run.  Tracked across the
+    # whole stream so a segment's process name is stable no matter how
+    # many empty segments the exporter skips.
+    next_attempt: Optional[int] = None
     for pid, seg in enumerate(_segments(records), start=1):
         header = next((r for r in seg if r.get("event") == "run_header"), {})
+        attempt = next_attempt
+        for r in seg:
+            if (r.get("event") == "control"
+                    and r.get("intervention") == "restart"
+                    and isinstance(r.get("attempt"), int)):
+                next_attempt = r["attempt"]
         spans = _spans_in(seg)
         if not spans:
             continue
@@ -109,9 +121,19 @@ def to_chrome_trace(records: List[Dict[str, Any]],
                   if isinstance(wall, (int, float)) and wall0 is not None
                   else 0.0)
         label = header.get("run_name") or run_name
+        # stable human-readable process name: segment-<n> is the
+        # position in the FULL stream (empty segments included, so
+        # names never renumber when a segment gains its first span),
+        # plus the supervisor restart attempt that produced it and
+        # whether it resumed from a checkpoint
+        seg_name = f"segment-{pid}"
+        if isinstance(attempt, int):
+            seg_name += f" restart-attempt-{attempt}"
+        elif header.get("resumed"):
+            seg_name += " resumed"
         events.append({"ph": "M", "name": "process_name", "pid": pid,
                        "tid": 0,
-                       "args": {"name": f"{label} (segment {pid}, "
+                       "args": {"name": f"{label} ({seg_name}, "
                                         f"run {header.get('run_id', '?')})"}})
         events.append({"ph": "M", "name": "thread_name", "pid": pid,
                        "tid": 1, "args": {"name": "rounds"}})
@@ -258,6 +280,11 @@ def selftest() -> None:
         assert sorted(e["args"]["round_index"] for e in rounds) == [0, 1, 2, 3]
         pids = {e["pid"] for e in rounds}
         assert len(pids) == 2, f"resumed run must split segments: {pids}"
+        names = {e["pid"]: e["args"]["name"]
+                 for e in trace["traceEvents"]
+                 if e.get("ph") == "M" and e.get("name") == "process_name"}
+        assert "segment-1" in names[1], names
+        assert "segment-2 resumed" in names[2], names
 
 
 def main(argv: Optional[List[str]] = None) -> int:

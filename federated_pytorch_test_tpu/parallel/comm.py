@@ -56,6 +56,19 @@ def federated_mean(tree, K: int, axis_name: str = CLIENT_AXIS):
     return jax.tree.map(lambda x: x / K, federated_sum(tree, axis_name))
 
 
+def per_client_norms(stack: jnp.ndarray, ref: jnp.ndarray) -> jnp.ndarray:
+    """``||x_k - ref||_2`` for every local client: [K_local, n] -> [K_local].
+
+    The client-ledger probe (obs/clients.py): computed on the exact
+    tensors the round folds — before guard neutralization, so NaN/inf
+    corruption stays visible per-client even when the guard rewrites
+    the offending row to ``z``.  Shard-local (no collective); the
+    [K_local] output rides the client-sharded out-spec to a global [K].
+    """
+    d = stack - ref[None, :]
+    return jnp.sqrt(jnp.sum(d * d, axis=1))
+
+
 def decode_stack(payloads, compressor, n: int, scratch=None) -> jnp.ndarray:
     """Dense reconstructions [K_local, n] of a client-stacked payload tree.
 

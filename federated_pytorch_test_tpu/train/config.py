@@ -340,6 +340,18 @@ class FederatedConfig:
     # the price of a second compile per program).
     cost_ledger: bool = True
 
+    # client-grain flight recorder (obs/clients.py) — default ON: one
+    # additive `client` record per communication round (schema v10)
+    # with per-client update norms, dist-to-z, loss shares, guard
+    # verdicts, fault tags, async staleness/admission, and churn
+    # membership, feeding the ClientLedger CLI's anomaly ranking and
+    # cohort rollup.  The probe adds two [K_local] norm outputs to the
+    # comm/fused programs and host-side list assembly per round; the
+    # folded update itself is untouched, and --no-client-ledger
+    # rebuilds the literal pre-probe programs (params bitwise
+    # identical, tested).
+    client_ledger: bool = True
+
     # persistent XLA compile-cache directory (utils/compile_cache.py):
     # None -> auto (FEDTPU_COMPILE_CACHE_DIR env, else tests/.jax_cache
     # with an XDG fallback); the literal string "none" disables the

@@ -77,6 +77,7 @@ class CPCTrainer:
                  init_seed: int = 0, num_devices: Optional[int] = None,
                  sanitize: bool = False, retrace_sentinel: bool = False,
                  donate: Optional[bool] = None, cost_ledger: bool = True,
+                 client_ledger: bool = True,
                  elastic_resume: bool = False):
         self.data = data
         self.K = data.K
@@ -105,6 +106,10 @@ class CPCTrainer:
         # device-cost ledger (obs/costs.py, classifier-engine parity):
         # default ON; None rebuilds the uninstrumented chain
         self._ledger = CostLedger() if cost_ledger else None
+        # client-grain flight recorder (obs/clients.py, classifier-engine
+        # parity): static probe mode — off rebuilds the literal pre-probe
+        # round program
+        self._client_probe = bool(client_ledger)
         self.models = {
             "encoder": EncoderCNN(latent_dim=latent_dim),
             "contextgen": ContextgenCNN(latent_dim=latent_dim),
@@ -252,6 +257,11 @@ class CPCTrainer:
             return xflat, os, jnp.sum(losses)
 
         sanitize = self.sanitize
+        client_probe = self._client_probe
+        if client_probe:
+            from federated_pytorch_test_tpu.parallel.comm import (
+                per_client_norms,
+            )
 
         def round_shard(state: CPCState, z, opt_state, data):
             # data: [K_local, Niter, nbatch, ps, ps, 8]
@@ -284,6 +294,11 @@ class CPCTrainer:
             )(sub)                                        # write-back (:299-304)
             out = (state._replace(**{mdl: sub}), znew, opt_state, dual,
                    losses)
+            if client_probe:
+                # ledger probes (obs/clients.py): per-client distance of
+                # the shipped block vector to the old and new consensus
+                out = out + (per_client_norms(xflat, z),
+                             per_client_norms(xflat, znew))
             return (errk, out) if sanitize else out
 
         def init_opt(state: CPCState):
@@ -296,6 +311,8 @@ class CPCTrainer:
         spec_r = P()
         state_spec = CPCState(spec_c, spec_c, spec_c)
         out_specs = (state_spec, spec_r, spec_c, spec_r, spec_c)
+        if client_probe:
+            out_specs = out_specs + (spec_c, spec_c)   # cl_nrm, cl_dist
         if self.sanitize:
             # checkify already happened inside round_shard (vmap-of-
             # checkify, see above), so instrument with sanitize=False and
@@ -616,15 +633,20 @@ class CPCTrainer:
                                 # its dispatch (graftcheck JG104)
                                 self._obs_sync(obs, staged)
                                 t_staged = time.perf_counter()
-                                state, z, opt_state, dual, losses = fn(
-                                    state, z, opt_state, staged)
+                                out = fn(state, z, opt_state, staged)
+                                cl_nrm = cl_dist = None
+                                if self._client_probe:
+                                    cl_nrm, cl_dist = out[-2], out[-1]
+                                    out = out[:-2]
+                                state, z, opt_state, dual, losses = out
+                                loss_host = np.asarray(fetch(losses))
                                 rec = dict(nloop=nloop, model=mdl, block=ci,
                                            nadmm=nadmm, N=N,
                                            # the whole round is one jitted
                                            # dispatch by construction here
                                            host_dispatches=1,
                                            dual_residual=float(dual),
-                                           loss=float(np.sum(fetch(losses))),
+                                           loss=float(np.sum(loss_host)),
                                            # dense f32 block payload from all
                                            # K clients (schema parity with
                                            # the classifier engine; CPC has
@@ -674,6 +696,25 @@ class CPCTrainer:
                                         bytes_dense=4 * N * self.K,
                                         t_start=t_round,
                                         **device_memory_stats()))
+                                    if self._client_probe:
+                                        # client flight-recorder line
+                                        # (schema v10, obs/clients.py):
+                                        # CPC is full-participation with
+                                        # a dense f32 block payload
+                                        from federated_pytorch_test_tpu\
+                                            .obs.clients import (
+                                                client_round_fields,
+                                            )
+                                        ones = np.ones(self.K, np.float32)
+                                        obs.client_event(client_round_fields(
+                                            ridx, self.K,
+                                            update_norm=np.asarray(
+                                                fetch(cl_nrm)),
+                                            dist_z=np.asarray(
+                                                fetch(cl_dist)),
+                                            loss=loss_host,
+                                            active=ones, weight=ones,
+                                            payload_bytes=4 * N))
                                     if obs.enabled:
                                         rspan = (rrec or {}).get("span_id")
                                         obs.span("stage", t_round, t_staged,

@@ -237,6 +237,11 @@ class BlockwiseFederatedTrainer:
         # Both ride in the mid-run checkpoint meta so resume replays them.
         self._quarantine = np.zeros(cfg.K, np.int64)
         self._guard_scale = float("inf")
+        # client-ledger staging area (obs/clients.py): the activity/
+        # guard paths stash this round's per-client HOST arrays here and
+        # _emit_client_record folds them into one `client` record —
+        # advisory telemetry only, never read by the math
+        self._client_round: dict = {}
         # buffered-async staleness ledger (cfg.async_rounds): per-client
         # scheduled arrival round (-1 = nothing in flight) and dispatch
         # round of the in-flight update, plus the cumulative admission-
@@ -604,6 +609,14 @@ class BlockwiseFederatedTrainer:
         has_corrupt = faults_on and self.faults.corrupt > 0
         corrupt_mode, corrupt_scale = self.faults.mode, self.faults.scale
         mean_fn = self.mean_fn
+        # client-grain flight recorder (cfg.client_ledger, obs/clients.py):
+        # a STATIC probe mode — when off, the comm program below is the
+        # literal pre-probe chain (no extra outputs traced at all)
+        client_probe = self._client_probe
+        if client_probe:
+            from federated_pytorch_test_tpu.parallel.comm import (
+                per_client_norms,
+            )
 
         def _sel(active, new, old):
             """Per-leaf where(active_k, new, old) over the client axis —
@@ -706,6 +719,13 @@ class BlockwiseFederatedTrainer:
                     # stragglers' PRNG/residual state stays bit-untouched
                     comp_new = _sel(active, comp_new, comp_state)
                 comp_state = comp_new
+            cl_nrm = None
+            if client_probe:
+                # ledger probe: raw pre-guard per-client ||x_k - z|| on the
+                # exact folded tensors (post-corruption, post-decode) — a
+                # NaN/inf delta stays visible here even though the guard
+                # below rewrites the row to z
+                cl_nrm = per_client_norms(x, z)
             w = active
             if guard_on:
                 # update guards: every incoming delta must be finite and
@@ -753,6 +773,11 @@ class BlockwiseFederatedTrainer:
                 diag["guard_trips"] = n_trip
                 diag["guard_norm_mean"] = norm_mean
                 diag["n_ok"] = n_ok
+            cl_dist = None
+            if client_probe:
+                # ledger probe: post-fold ||x_k - z_new|| (guard-neutralised
+                # rows measure z -> z_new, i.e. how far the round moved)
+                cl_dist = per_client_norms(x, znew)
             params = state.params
             if algo.writeback:
                 wrote = jax.vmap(
@@ -771,6 +796,11 @@ class BlockwiseFederatedTrainer:
             out_state = ClientState(params, state.batch_stats,
                                     state.opt_state, comp_state)
             out = (out_state, znew, ynew, rho, x0, yhat0, diag)
+            if client_probe:
+                # probe outputs sit between the base tuple and the okf/
+                # scratch tail; the round loop pops from the end in the
+                # reverse order (scratch, okf, probes)
+                out = out + (cl_nrm, cl_dist)
             if guard_on:
                 # okf rides back to the host so the round loop can
                 # quarantine the offenders it names
@@ -807,6 +837,8 @@ class BlockwiseFederatedTrainer:
 
         comm_out = (state_specs, spec_r, spec_c, spec_r, spec_c,
                     spec_c, spec_r)
+        if client_probe:
+            comm_out = comm_out + (spec_c, spec_c)   # cl_nrm, cl_dist
         if guard_on:
             comm_out = comm_out + (spec_c,)      # okf verdicts to the host
         comm_in = (state_specs, spec_r, spec_c, spec_r, spec_c,
@@ -891,6 +923,7 @@ class BlockwiseFederatedTrainer:
         n = self.data.samples_per_client
         nB = steps * B
         guard_on = cfg.update_guard
+        client_probe = self._client_probe
 
         def local_keys(seed):
             # EXACTLY the host staging construction (_stage_epoch /
@@ -933,6 +966,8 @@ class BlockwiseFederatedTrainer:
         state_specs = ClientState(spec_c, spec_c, spec_c, spec_c)
         comm_out = (state_specs, spec_r, spec_c, spec_r, spec_c,
                     spec_c, spec_r)
+        if client_probe:
+            comm_out = comm_out + (spec_c, spec_c)   # cl_nrm, cl_dist
         if guard_on:
             comm_out = comm_out + (spec_c,)
         fused_fns = {}
@@ -1088,6 +1123,14 @@ class BlockwiseFederatedTrainer:
         return stage_global(self._participation_host(nloop, ci, nadmm),
                             client_sharding(self.mesh))
 
+    @property
+    def _client_probe(self) -> bool:
+        """Client-grain flight recorder live? (cfg.client_ledger,
+        obs/clients.py) — static: flips which comm/fused programs are
+        BUILT, so the off state is the literal pre-probe chain."""
+        return bool(getattr(self.cfg, "client_ledger", True)) \
+            and self.algo.communicates
+
     def _round_activity(self, nloop: int, ci: int, nadmm: int):
         """Compose participation sampling x quarantine x injected faults
         into this round's activity masks.
@@ -1130,6 +1173,8 @@ class BlockwiseFederatedTrainer:
             else:
                 host = self._participation_host(nloop, ci, nadmm)
                 dev = stage_global(host, client_sharding(self.mesh))
+            if self._client_probe:
+                self._client_round = {"active": host, "weight": host}
             return dev, dev, self._zero_corrupt, host, {}
         base = (np.ones(cfg.K, np.float32) if cfg.participation >= 1.0
                 else self._participation_host(nloop, ci, nadmm))
@@ -1153,6 +1198,17 @@ class BlockwiseFederatedTrainer:
                 fault_straggled=int(np.sum(comm * straggle)),
                 fault_corrupted=int(np.sum(corrupt)))
         counts.update(churn_counts)
+        if self._client_probe:
+            self._client_round = {
+                "active": comm, "weight": comm,
+                "quarantine": self._quarantine.copy(),   # round-start census
+                "dropped": base * ok * drop,
+                "straggled": comm * straggle,
+                "corrupted": corrupt,
+            }
+            if faults.churn_enabled:
+                self._client_round["members"] = \
+                    self._members.astype(np.float32)
         csh = client_sharding(self.mesh)
         return (stage_global(train, csh), stage_global(comm, csh),
                 stage_global(corrupt, csh), comm, counts)
@@ -1312,6 +1368,21 @@ class BlockwiseFederatedTrainer:
                 fault_straggled=int(np.sum(dispatch * straggle)),
                 fault_corrupted=int(np.sum(corrupt)))
         counts.update(churn_counts or {})
+        if self._client_probe:
+            self._client_round = {
+                "active": admit.astype(np.float32), "weight": w.copy(),
+                "quarantine": self._quarantine.copy(),
+                "dropped": base * ok * free * drop,
+                "straggled": dispatch * straggle,
+                "corrupted": corrupt,
+                # -1 = no arrival this round; rejects show up as
+                # staleness >= 0 with admitted == 0 (obs/clients.py)
+                "staleness": np.where(arrive, stale, -1).astype(np.int64),
+                "admitted": admit.astype(np.float32),
+            }
+            if faults.churn_enabled:
+                self._client_round["members"] = \
+                    self._members.astype(np.float32)
         csh = client_sharding(self.mesh)
         return (stage_global(train, csh), stage_global(w, csh),
                 stage_global(corrupt, csh), w, counts)
@@ -1336,6 +1407,8 @@ class BlockwiseFederatedTrainer:
         cfg = self.cfg
         okf_h = np.asarray(fetch(okf))
         tripped = (comm_host > 0) & (okf_h < 0.5)
+        if self._client_probe:
+            self._client_round["guard_ok"] = okf_h
         self._quarantine = np.maximum(self._quarantine - 1, 0)
         if cfg.quarantine_rounds > 0:
             self._quarantine[tripped] = cfg.quarantine_rounds
@@ -1344,6 +1417,29 @@ class BlockwiseFederatedTrainer:
             self._guard_scale = (
                 nm if not np.isfinite(self._guard_scale)
                 else 0.5 * self._guard_scale + 0.5 * nm)
+
+    def _emit_client_record(self, obs, round_index: int, N: int,
+                            loss_host, cl_nrm, cl_dist) -> None:
+        """Fold this round's per-client host arrays — the activity/guard
+        stash (``self._client_round``) plus the probe norms and [K] loss
+        vector the round sync already fetched — into one ``client``
+        record (schema v10, obs/clients.py).  Advisory telemetry: every
+        value here was computed anyway; nothing reads it back."""
+        from federated_pytorch_test_tpu.obs.clients import (
+            client_round_fields,
+        )
+        cr = self._client_round
+        fields = client_round_fields(
+            round_index, self.cfg.K,
+            update_norm=cl_nrm, dist_z=cl_dist, loss=loss_host,
+            weight=cr.get("weight"), active=cr.get("active"),
+            guard_ok=cr.get("guard_ok"), quarantine=cr.get("quarantine"),
+            dropped=cr.get("dropped"), straggled=cr.get("straggled"),
+            corrupted=cr.get("corrupted"), staleness=cr.get("staleness"),
+            admitted=cr.get("admitted"), members=cr.get("members"),
+            payload_bytes=self.round_bytes_on_wire(N, 1))
+        obs.client_event(fields)
+        self._client_round = {}
 
     def _want_device_data(self) -> bool:
         want = self.cfg.device_data
@@ -2144,6 +2240,7 @@ class BlockwiseFederatedTrainer:
                         q_start = (int(np.sum(self._quarantine > 0))
                                    if cfg.update_guard else 0)
                         loss_acc = None       # on-device [K] accumulator: the
+                        cl_nrm = cl_dist = None   # client-ledger probes
                         stage_s = 0.0         # host fetch happens ONCE per round
                         overlap_s = 0.0       # host staging hidden behind comm
                         phase_marks = []      # (name, cat, t0, t1) span bounds
@@ -2171,12 +2268,17 @@ class BlockwiseFederatedTrainer:
                                 self.client_norm, *self._dev_x,
                                 self._dev_w)
                             self._host_dispatches += 1
+                            # pop the variadic tail in reverse build
+                            # order: loss, okf verdicts, ledger probes
+                            loss_acc = out[-1]
+                            out = out[:-1]
                             if cfg.update_guard:
-                                (state, z, y, rho, x0, yhat0, diag, okf,
-                                 loss_acc) = out
-                            else:
-                                (state, z, y, rho, x0, yhat0, diag,
-                                 loss_acc) = out
+                                okf = out[-1]
+                                out = out[:-1]
+                            if self._client_probe:
+                                cl_nrm, cl_dist = out[-2], out[-1]
+                                out = out[:-2]
+                            state, z, y, rho, x0, yhat0, diag = out
                             diag = {k: float(v) for k, v in diag.items()}
                             if cfg.update_guard:
                                 self._apply_guard_verdicts(
@@ -2264,10 +2366,12 @@ class BlockwiseFederatedTrainer:
                                     scratch = out[-1]
                                     out = out[:-1]
                                 if cfg.update_guard:
-                                    (state, z, y, rho, x0, yhat0, diag,
-                                     okf) = out
-                                else:
-                                    state, z, y, rho, x0, yhat0, diag = out
+                                    okf = out[-1]
+                                    out = out[:-1]
+                                if self._client_probe:
+                                    cl_nrm, cl_dist = out[-2], out[-1]
+                                    out = out[:-2]
+                                state, z, y, rho, x0, yhat0, diag = out
                                 diag = {k: float(v)
                                         for k, v in diag.items()}
                                 if cfg.update_guard:
@@ -2301,8 +2405,14 @@ class BlockwiseFederatedTrainer:
                         # isolates host shuffle + H2D copy — with the epoch
                         # prefetch it should stay near zero unless the host
                         # pipeline is the bottleneck
-                        loss_sum = (float(np.sum(fetch(loss_acc)))
-                                    if loss_acc is not None else 0.0)
+                        loss_host = (np.asarray(fetch(loss_acc))
+                                     if loss_acc is not None else None)
+                        loss_sum = (float(np.sum(loss_host))
+                                    if loss_host is not None else 0.0)
+                        if cl_nrm is not None:
+                            # the probes ride the same single round sync
+                            cl_nrm = np.asarray(fetch(cl_nrm))
+                            cl_dist = np.asarray(fetch(cl_dist))
                         sync_s = time.perf_counter() - t_sync
                         if obs.enabled:
                             phase_marks.append(
@@ -2399,6 +2509,13 @@ class BlockwiseFederatedTrainer:
                                 extra["bytes_dense"] = 4 * N * int(
                                     diag.get("n_active", cfg.K))
                             rrec = obs.round(extra)
+                            if self._client_probe:
+                                # the round's flight-recorder line: one
+                                # additive `client` record right behind
+                                # the round record (schema v10)
+                                self._emit_client_record(
+                                    obs, extra["round_index"], N,
+                                    loss_host, cl_nrm, cl_dist)
                             if obs.enabled:
                                 rspan = (rrec or {}).get("span_id")
                                 ridx = extra["round_index"]

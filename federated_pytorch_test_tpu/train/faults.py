@@ -90,6 +90,14 @@ from jax import lax
 
 CORRUPT_MODES = ("nan", "inf", "signflip", "scale", "innerprod", "collude")
 
+#: canonical fault-tag names, in precedence order (drop beats straggle
+#: beats corrupt) — these ARE the per-client list-field names the
+#: engines write into schema-v10 `client` records (obs/clients.py), so
+#: a ledger consumer can map a glyph/field back to the injection family
+#: without guessing.  The delay family surfaces as `staleness`/
+#: `admitted` and churn as `members` in the same records.
+FAULT_TAGS = ("dropped", "straggled", "corrupted")
+
 
 class RoundFaults(NamedTuple):
     """Per-client 0/1 fault indicators for one communication round."""

@@ -9,7 +9,11 @@ machinery is inherited wholesale — including ``--fused-rounds`` (the
 per-epoch reparametrisation PRNG keys these losses consume are derived
 on-device inside the fused round from the same counter-keyed seeds the
 host loop uses, so fused VAE rounds stay bit-identical), ``--donate``
-buffer donation, and ``--async-checkpoint`` background mid-run saves.
+buffer donation, ``--async-checkpoint`` background mid-run saves, and
+the client-grain flight recorder (``cfg.client_ledger``,
+obs/clients.py: the inherited comm round emits per-client ELBO-loss
+shares and update norms into `client` records, so the anomaly ranking
+and cohort rollup work unchanged on VAE runs).
 """
 
 from __future__ import annotations

@@ -457,9 +457,11 @@ class TestPartialParticipation:
         xb, yb, wb = t._stage_epoch()
         state, _ = train_epoch(state, y, t.client_norm, t._epoch_keys(),
                                xb, yb, wb, z, rho, amask)
-        _, _, y_new, _, _, _, diag = comm_fns["plain"](
+        # base 7-tuple; the tail is variadic (client-ledger probes)
+        outs = comm_fns["plain"](
             state, z, y, rho, dummy, dummy, amask,
             t._zero_corrupt, t._inf_bound)
+        _, _, y_new, _, _, _, diag = outs[:7]
         y_new = np.asarray(jax.device_get(y_new))
         assert float(diag["n_active"]) == active.sum()
         assert np.isfinite(float(diag["primal_residual"]))

@@ -1,0 +1,482 @@
+"""Client-grain flight-recorder tests (obs/clients.py + wiring).
+
+Covers the schema v1->v10 ladder and the new ``client`` record kind,
+the ClientLedger accumulation units against hand-computed values
+(guards, async staleness/admission, churn joins/leaves, bytes), the
+deterministic anomaly ranking — byte-identical when recomputed from the
+same stream, corrupt client first, ties by id — the engine wiring (one
+client record per comm round, NaN visible pre-guard, off-mode bitwise
+parity with the pre-probe program), the observe-only advisory
+client-health policy rule and its replay derivation, and the CLI
+exit-code contract (``--expect-top`` is the chaos CI gate).
+"""
+
+import json
+import math
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import flax.linen as nn
+
+from federated_pytorch_test_tpu.data.cifar10 import FederatedCifar10
+from federated_pytorch_test_tpu.models.base import (
+    BlockModule,
+    elu,
+    flatten,
+    max_pool_2x2,
+    pairs,
+)
+from federated_pytorch_test_tpu.obs import (
+    SCHEMA_VERSION,
+    SchemaError,
+    validate_record,
+)
+from federated_pytorch_test_tpu.obs.clients import (
+    ClientLedger,
+    client_round_fields,
+    format_clients,
+    ledger_from_records,
+    main as clients_main,
+    selftest as clients_selftest,
+    summarize_clients,
+)
+from federated_pytorch_test_tpu.obs.report import read_records, summarize
+from federated_pytorch_test_tpu.control.policy import (
+    SCOPE_ADVISORY,
+    Controller,
+    ControlPolicy,
+)
+from federated_pytorch_test_tpu.control.replay import (
+    derive_segment_decisions,
+)
+from federated_pytorch_test_tpu.train import (
+    AdmmConsensus,
+    BlockwiseFederatedTrainer,
+    FederatedConfig,
+)
+
+pytestmark = pytest.mark.obsclients
+
+K = 4
+
+
+class TinyNet(BlockModule):
+    """Same 2-block toy CNN as the other obs test files."""
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = max_pool_2x2(elu(nn.Conv(4, (5, 5), strides=(2, 2),
+                                     name="conv1")(x)))
+        x = flatten(x)
+        return nn.Dense(10, name="fc1")(x)
+
+    def param_order(self):
+        return pairs("conv1", "fc1")
+
+    def train_order_block_ids(self):
+        return [[0, 1], [2, 3]]
+
+    def linear_layer_ids(self):
+        return [1]
+
+
+@pytest.fixture(scope="module")
+def data():
+    return FederatedCifar10(K=K, batch=16, limit_per_client=32,
+                            limit_test=32)
+
+
+def small_cfg(**kw):
+    base = dict(K=K, Nloop=1, Nepoch=1, Nadmm=2, default_batch=16,
+                check_results=False, admm_rho0=0.1, obs_sinks="memory")
+    base.update(kw)
+    return FederatedConfig(**base)
+
+
+def round_record(i=0, ver=SCHEMA_VERSION, **kw):
+    rec = {"event": "round", "schema": ver, "run_id": "t" * 8,
+           "engine": "classifier", "round_index": i, "round_seconds": 0.5,
+           "loss": 1.0 - 0.1 * i}
+    rec.update(kw)
+    return rec
+
+
+def client_record(i=0, k=K, ver=SCHEMA_VERSION, **kw):
+    body = client_round_fields(i, k, **kw)
+    return dict({"event": "client", "schema": ver, "run_id": "t" * 8},
+                **body)
+
+
+# ----------------------------------------------------------------------
+# schema ladder v1 -> v10
+
+
+class TestSchemaLadder:
+    def test_v10_reader_accepts_every_prior_version(self):
+        for ver in range(1, SCHEMA_VERSION + 1):
+            validate_record(round_record(ver=ver))
+        validate_record(client_record(update_norm=[1.0] * K,
+                                      guard_ok=[1.0] * K,
+                                      staleness=[0, 1, -1, 2],
+                                      payload_bytes=128))
+
+    def test_newer_schema_rejected(self):
+        with pytest.raises(SchemaError, match="newer"):
+            validate_record(client_record(ver=SCHEMA_VERSION + 1))
+
+    def test_unknown_fields_pass_on_client_records(self):
+        rec = client_record()
+        rec["field_from_v11"] = "future"
+        validate_record(rec)
+
+    def test_client_fields_typed(self):
+        bad = client_record()
+        bad["update_norm"] = "not-a-list"
+        with pytest.raises(SchemaError, match="update_norm"):
+            validate_record(bad)
+
+    def test_client_fields_rejected_on_summary(self):
+        with pytest.raises(SchemaError, match="not valid"):
+            validate_record({"event": "summary", "schema": SCHEMA_VERSION,
+                             "run_id": "r" * 8, "status": "completed",
+                             "rounds": 1, "update_norm": [1.0]})
+
+    def test_clients_count_required(self):
+        rec = client_record()
+        del rec["clients"]
+        with pytest.raises(SchemaError, match="clients"):
+            validate_record(rec)
+
+
+# ----------------------------------------------------------------------
+# record assembly
+
+
+class TestClientRoundFields:
+    def test_length_mismatch_raises(self):
+        with pytest.raises(ValueError, match="expected length 4"):
+            client_round_fields(0, 4, update_norm=[1.0, 2.0])
+
+    def test_numpy_coerced_to_python_lists(self):
+        f = client_round_fields(3, 2, update_norm=np.float32([1, 2]),
+                                staleness=np.int64([0, -1]),
+                                quarantine=np.array([0.0, 1.0]))
+        assert f["update_norm"] == [1.0, 2.0]
+        assert f["staleness"] == [0, -1]
+        assert f["quarantine"] == [0, 1]
+        assert all(isinstance(v, float) for v in f["update_norm"])
+        assert all(isinstance(v, int) for v in f["staleness"])
+
+    def test_nan_survives_json_round_trip(self):
+        # the JSONL sink uses plain json.dumps (allow_nan) — a corrupt
+        # client's NaN norm must come back as NaN, not null or an error
+        f = client_round_fields(0, 2, update_norm=[float("nan"), 1.0])
+        back = json.loads(json.dumps(f))
+        assert math.isnan(back["update_norm"][0])
+        assert back["update_norm"][1] == 1.0
+
+    def test_absent_fields_omitted(self):
+        f = client_round_fields(0, 2)
+        assert set(f) == {"round_index", "clients"}
+
+
+# ----------------------------------------------------------------------
+# ledger accumulation units vs hand-computed values
+
+
+class TestLedgerUnits:
+    def test_norms_guards_and_bytes(self):
+        recs = [
+            client_record(0, update_norm=[1.0, 3.0, float("nan"), 2.0],
+                          active=[1, 1, 1, 1], guard_ok=[1, 1, 0, 1],
+                          payload_bytes=10),
+            client_record(1, update_norm=[2.0, 3.0, float("inf"), 2.0],
+                          active=[1, 1, 1, 0], guard_ok=[1, 1, 0, 0],
+                          payload_bytes=10),
+        ]
+        led = ledger_from_records(recs)
+        assert led.clients == K and led.records == 2
+        # norm_n counts FINITE norms regardless of activity (client 3's
+        # round-1 norm is a real shipped value even though it sat out)
+        np.testing.assert_array_equal(led.norm_n, [2, 2, 0, 2])
+        np.testing.assert_array_equal(led.nonfinite, [0, 0, 2, 0])
+        np.testing.assert_allclose(led.mean_norms()[:2], [1.5, 3.0])
+        assert np.isnan(led.mean_norms()[2])      # no finite norms seen
+        # guard checks/fails only count ACTIVE clients: client 3's
+        # guard_ok=0 in round 1 is idle, not a rejection
+        np.testing.assert_array_equal(led.guard_checks, [2, 2, 2, 1])
+        np.testing.assert_array_equal(led.guard_fails, [0, 0, 2, 0])
+        # bytes accrue per ACTIVE round
+        np.testing.assert_array_equal(led.bytes, [20, 20, 20, 10])
+
+    def test_async_staleness_admission_semantics(self):
+        # staleness -1 = no arrival; arrived-but-rejected counts in
+        # rejects and contributes nothing to the admitted-staleness mean
+        recs = [
+            client_record(0, staleness=[0, 2, -1, 5],
+                          admitted=[1, 1, 0, 0]),
+            client_record(1, staleness=[0, 4, -1, -1],
+                          admitted=[1, 1, 0, 0]),
+        ]
+        led = ledger_from_records(recs)
+        np.testing.assert_array_equal(led.arrivals, [2, 2, 0, 1])
+        np.testing.assert_array_equal(led.admits, [2, 2, 0, 0])
+        np.testing.assert_array_equal(led.rejects, [0, 0, 0, 1])
+        np.testing.assert_array_equal(led.stale_sum, [0, 6, 0, 0])
+
+    def test_churn_joins_and_leaves(self):
+        recs = [
+            client_record(0, members=[1, 1, 1, 1]),
+            client_record(1, members=[1, 0, 1, 1]),   # c1 leaves
+            client_record(2, members=[1, 1, 1, 1]),   # c1 rejoins
+        ]
+        led = ledger_from_records(recs)
+        np.testing.assert_array_equal(led.member_rounds, [3, 2, 3, 3])
+        np.testing.assert_array_equal(led.leaves, [0, 1, 0, 0])
+        np.testing.assert_array_equal(led.joins, [0, 1, 0, 0])
+
+    def test_fault_tags_and_timeline_glyphs(self):
+        recs = [
+            client_record(0, active=[1, 1, 1, 1],
+                          dropped=[0, 1, 0, 0], straggled=[0, 0, 1, 0],
+                          corrupted=[0, 0, 0, 1]),
+            client_record(1, active=[1, 1, 1, 1], quarantine=[0, 0, 0, 1]),
+        ]
+        led = ledger_from_records(recs)
+        np.testing.assert_array_equal(led.drops, [0, 1, 0, 0])
+        np.testing.assert_array_equal(led.straggles, [0, 0, 1, 0])
+        np.testing.assert_array_equal(led.corrupts, [0, 0, 0, 1])
+        np.testing.assert_array_equal(led.quar_rounds, [0, 0, 0, 1])
+        assert led.timelines() == ["..", "D.", "S.", "Cq"]
+
+    def test_non_client_events_ignored(self):
+        led = ClientLedger()
+        led.observe(round_record())
+        led.observe({"event": "summary", "schema": SCHEMA_VERSION,
+                     "run_id": "t" * 8, "status": "completed", "rounds": 1})
+        assert led.records == 0 and led.clients == 0
+        assert summarize_clients([round_record()]) == {}
+
+
+# ----------------------------------------------------------------------
+# anomaly ranking: determinism + ordering contract
+
+
+class TestAnomalyRanking:
+    def _stream(self):
+        nan = float("nan")
+        recs = []
+        for i in range(4):
+            recs.append(client_record(
+                i, update_norm=[1.0, 1.1, nan, 0.9],
+                active=[1, 1, 1, 1], guard_ok=[1, 1, 0, 1],
+                staleness=[0, 3, 0, 0], admitted=[1, 1, 1, 1],
+                payload_bytes=8))
+        return recs
+
+    def test_corrupt_client_ranks_first(self):
+        rank = ledger_from_records(self._stream()).ranking()
+        assert rank[0]["client"] == 2
+        assert rank[0]["nonfinite"] == 4 and rank[0]["guard_fails"] == 4
+
+    def test_recompute_is_byte_identical(self):
+        recs = self._stream()
+        a = ledger_from_records(recs).anomaly_scores()
+        b = ledger_from_records(list(recs)).anomaly_scores()
+        assert a.dtype == np.float64
+        assert a.tobytes() == b.tobytes()
+
+    def test_segment_split_does_not_move_scores(self):
+        # resume/restart segments just append records; the ledger is a
+        # pure function of file order, so a header in the middle of the
+        # stream must not change anything
+        recs = self._stream()
+        header = {"event": "run_header", "schema": SCHEMA_VERSION,
+                  "run_id": "u" * 8, "engine": "classifier",
+                  "time_unix": 2.0, "resumed": True, "rounds_prior": 2}
+        split = recs[:2] + [header] + recs[2:]
+        a = ledger_from_records(recs).anomaly_scores()
+        b = ledger_from_records(split).anomaly_scores()
+        assert a.tobytes() == b.tobytes()
+
+    def test_ties_broken_by_ascending_id(self):
+        recs = [client_record(0, update_norm=[1.0] * K,
+                              active=[1] * K, guard_ok=[1] * K)]
+        rank = ledger_from_records(recs).ranking()
+        assert [r["client"] for r in rank] == [0, 1, 2, 3]
+        assert all(r["score"] == 0.0 for r in rank)
+
+    def test_format_handles_empty_and_full(self):
+        assert "no client records" in format_clients(ClientLedger())
+        txt = format_clients(ledger_from_records(self._stream()),
+                             cohorts=2)
+        assert "anomaly ranking" in txt and "cohort 0" in txt
+
+    def test_selftest_passes(self):
+        assert "OK" in clients_selftest()
+
+
+# ----------------------------------------------------------------------
+# engine integration: comm rounds emit client records
+
+
+@pytest.fixture(scope="module")
+def chaos_run(data, tmp_path_factory):
+    """Seeded corrupt=nan run: client 1 ships NaN every round."""
+    d = tmp_path_factory.mktemp("chaos_run")
+    cfg = small_cfg(obs_dir=str(d), obs_sinks="jsonl,memory",
+                    fault_spec="corrupt=1,mode=nan,clients=1,seed=7",
+                    update_guard=True)
+    t = BlockwiseFederatedTrainer(TinyNet(), cfg, data, AdmmConsensus())
+    state, hist = t.run(log=lambda m: None)
+    jsonls = [os.path.join(d, f) for f in os.listdir(d)
+              if f.endswith(".jsonl")]
+    assert len(jsonls) == 1
+    return t, state, hist, jsonls[0]
+
+
+class TestEngineIntegration:
+    def test_one_client_record_per_comm_round(self, chaos_run):
+        t, _, hist, _ = chaos_run
+        mem = t.obs_recorder.memory
+        crecs = [r for r in mem if r["event"] == "client"]
+        rounds = [r for r in mem if r["event"] == "round"]
+        assert len(crecs) == len(rounds) > 0
+        for c in crecs:
+            validate_record(c)
+            assert c["clients"] == K
+            assert len(c["update_norm"]) == K
+            assert c["payload_bytes"] > 0
+
+    def test_nan_visible_before_guard_neutralization(self, chaos_run):
+        t, _, _, _ = chaos_run
+        crecs = [r for r in t.obs_recorder.memory
+                 if r["event"] == "client"]
+        # the guard neutralizes client 1's update in the MATH, but the
+        # probe runs first: its shipped norm must be recorded non-finite
+        assert any(not math.isfinite(c["update_norm"][1]) for c in crecs)
+        # and the guard verdict for client 1 must be a recorded failure
+        assert any(c.get("guard_ok", [1] * K)[1] < 0.5 for c in crecs)
+
+    def test_ranking_from_file_names_the_corrupt_client(self, chaos_run):
+        _, _, _, path = chaos_run
+        led = ledger_from_records(read_records(path))
+        assert led.ranking()[0]["client"] == 1
+        s = summarize(read_records(path))
+        assert s["top_offender"] == 1
+        assert s["client_records"] == led.records
+
+    def test_cli_expect_top_gate(self, chaos_run, capsys):
+        _, _, _, path = chaos_run
+        assert clients_main([path, "--expect-top", "1"]) == 0
+        assert clients_main([path, "--expect-top", "0"]) == 2
+        capsys.readouterr()
+
+    def test_cli_json_recompute_byte_identical(self, chaos_run, capsys):
+        _, _, _, path = chaos_run
+        assert clients_main([path, "--json"]) == 0
+        first = capsys.readouterr().out
+        assert clients_main([path, "--json"]) == 0
+        second = capsys.readouterr().out
+        assert first == second
+        assert json.loads(first)["ranking"][0]["client"] == 1
+
+    def test_off_mode_emits_no_client_records(self, data):
+        cfg = small_cfg(client_ledger=False)
+        t = BlockwiseFederatedTrainer(TinyNet(), cfg, data,
+                                      AdmmConsensus())
+        t.run(log=lambda m: None)
+        assert not [r for r in t.obs_recorder.memory
+                    if r["event"] == "client"]
+
+
+class TestBitwiseIdentity:
+    def test_client_ledger_toggle_does_not_move_math(self, data):
+        def run(**kw):
+            cfg = small_cfg(seed=3, **kw)
+            t = BlockwiseFederatedTrainer(TinyNet(), cfg, data,
+                                          AdmmConsensus())
+            state, hist = t.run(log=lambda m: None)
+            return jax.device_get(state.params), hist
+
+        p_on, h_on = run(client_ledger=True, obs_sinks="memory")
+        p_off, h_off = run(client_ledger=False, obs_sinks="memory")
+        p_dark, _ = run(client_ledger=True, obs_sinks="none")
+        for a, b in zip(jax.tree_util.tree_leaves(p_on),
+                        jax.tree_util.tree_leaves(p_off)):
+            np.testing.assert_array_equal(a, b)
+        for a, b in zip(jax.tree_util.tree_leaves(p_on),
+                        jax.tree_util.tree_leaves(p_dark)):
+            np.testing.assert_array_equal(a, b)
+        assert [r["loss"] for r in h_on] == [r["loss"] for r in h_off]
+
+
+# ----------------------------------------------------------------------
+# advisory client-health policy rule + replay derivation
+
+
+class TestAdvisoryClientHealth:
+    def _sick_stream(self, rounds=4):
+        recs = []
+        for i in range(rounds):
+            recs.append(round_record(i))
+            recs.append(client_record(
+                i, update_norm=[1.0, float("nan"), 1.0, 1.0],
+                active=[1, 1, 1, 1], guard_ok=[1, 0, 1, 1]))
+        return recs
+
+    def test_flag_clients_fires_with_advisory_scope(self):
+        pol = ControlPolicy(preset="default")
+        fired = []
+        for rec in self._sick_stream():
+            fired.extend(pol.observe(rec))
+        flags = [d for d in fired if d.intervention == "flag_clients"]
+        assert flags, "persistent sick client never flagged"
+        d = flags[0]
+        assert d.scope == SCOPE_ADVISORY
+        assert d.to_value == [1]
+        validate_record(dict(d.fields(source="policy", mode="observe",
+                                      applied=False),
+                             event="control", schema=SCHEMA_VERSION,
+                             run_id="t" * 8))
+
+    def test_act_mode_never_applies_advisory(self):
+        ctl = Controller(ControlPolicy(preset="default"), mode="act",
+                         can_restart=True)
+        for rec in self._sick_stream():
+            ctl.observe(rec)
+        flags = [r for r in ctl.records
+                 if r["intervention"] == "flag_clients"]
+        assert flags and all(r["applied"] is False for r in flags)
+        assert not ctl.take_round() and not ctl.take_block()
+        assert ctl.take_restart() is None
+
+    def test_replay_derives_the_same_decisions(self):
+        header = {"event": "run_header", "schema": SCHEMA_VERSION,
+                  "run_id": "t" * 8, "engine": "classifier",
+                  "time_unix": 1.0,
+                  "config": {"control": "observe",
+                             "control_policy": "default"}}
+        segment = [header] + self._sick_stream()
+        derived = derive_segment_decisions(segment)
+        assert derived is not None
+        flags = [r for r in derived
+                 if r["intervention"] == "flag_clients"]
+        assert flags and flags[0]["to_value"] == [1]
+        assert flags[0]["scope"] == SCOPE_ADVISORY
+        # deriving twice is deterministic (the replay contract)
+        assert derive_segment_decisions(segment) == derived
+
+    def test_healthy_stream_fires_nothing(self):
+        pol = ControlPolicy(preset="default")
+        fired = []
+        for i in range(4):
+            fired.extend(pol.observe(round_record(i)))
+            fired.extend(pol.observe(client_record(
+                i, update_norm=[1.0] * K, active=[1] * K,
+                guard_ok=[1] * K)))
+        assert not [d for d in fired
+                    if d.intervention == "flag_clients"]
