@@ -27,10 +27,14 @@ of killing the job:
   3. ``reduced_cohort`` — halve client participation (floor 0.25).
 
   A stage override that would violate an engine construction rule
-  (e.g. ``update_guard`` under ``bb_update``) is skipped, not forced —
-  degradation must never introduce a new failure mode.  Every override
-  and every restart is appended to the stream as a ``control`` record
-  with ``source="supervisor"``.
+  (e.g. ``update_guard`` under ``bb_update``, or a compress escalation
+  on the CPC engine, which has no compression path) is skipped, not
+  forced — degradation must never introduce a new failure mode.  Engine
+  incompatibilities are declared in :data:`ENGINE_LADDER_EXCLUSIONS`
+  and every suppressed rung field is logged as a ``ladder_override``
+  control record with ``applied: false`` and the skip reason.  Every
+  override and every restart is appended to the stream as a ``control``
+  record with ``source="supervisor"``.
 """
 
 from __future__ import annotations
@@ -91,8 +95,22 @@ def restart_backoff_seconds(base: float, seed: int, attempt: int) -> float:
 
 # -- degradation ladder -----------------------------------------------
 
+#: ladder fields an engine's constructor rejects outright.  The ladder
+#: must never degrade a run into a config the engine cannot build:
+#: classifier and VAE share the full blockwise feature set, while the
+#: CPC chain has no compression path (the residual/error-feedback
+#: machinery assumes the classifier's blockwise layout), so the shield
+#: rung's compress escalation is skipped there — with a logged reason —
+#: rather than forced into a constructor ValueError.
+ENGINE_LADDER_EXCLUSIONS: Dict[str, Tuple[str, ...]] = {
+    "classifier": (),
+    "vae": (),
+    "cpc": ("compress",),
+}
 
-def _stage_shield(cfg) -> Dict[str, Any]:
+
+def _stage_shield(cfg, engine: str = "classifier") -> Dict[str, Any]:
+    excluded = ENGINE_LADDER_EXCLUSIONS.get(engine, ())
     ov: Dict[str, Any] = {}
     # guards mask poisoned updates pre-aggregation; forbidden under
     # bb_update (engine constructor rule), so skip rather than crash
@@ -101,7 +119,7 @@ def _stage_shield(cfg) -> Dict[str, Any]:
             ov["update_guard"] = True
         if cfg.quarantine_rounds < 2:
             ov["quarantine_rounds"] = 2
-    if cfg.compress in COMPRESS_LADDER:
+    if "compress" not in excluded and cfg.compress in COMPRESS_LADDER:
         idx = COMPRESS_LADDER.index(cfg.compress)
         cap = (COMPRESS_LADDER.index("q4") if cfg.fused_collective
                else len(COMPRESS_LADDER) - 1)
@@ -110,18 +128,20 @@ def _stage_shield(cfg) -> Dict[str, Any]:
     return ov
 
 
-def _stage_robust_agg(cfg) -> Dict[str, Any]:
+def _stage_robust_agg(cfg, engine: str = "classifier") -> Dict[str, Any]:
     # fused_collective/sharded_update replace the aggregation chokepoint
     # the robust estimators need (engine constructor rule)
     if (cfg.robust_agg == "none" and not cfg.fused_collective
-            and not cfg.sharded_update):
+            and not cfg.sharded_update
+            and "robust_agg" not in ENGINE_LADDER_EXCLUSIONS.get(engine, ())):
         return {"robust_agg": "median"}
     return {}
 
 
-def _stage_reduced_cohort(cfg) -> Dict[str, Any]:
+def _stage_reduced_cohort(cfg, engine: str = "classifier") -> Dict[str, Any]:
     # partial participation is forbidden under bb_update
-    if getattr(cfg, "bb_update", False):
+    if (getattr(cfg, "bb_update", False)
+            or "participation" in ENGINE_LADDER_EXCLUSIONS.get(engine, ())):
         return {}
     p = float(cfg.participation)
     if p > 0.5:
@@ -154,26 +174,84 @@ def surviving_device_count(devices: int, K: int) -> int:
     return devices
 
 
-def ladder_overrides(cfg, attempt: int):
+def ladder_overrides(cfg, attempt: int, engine: str = "classifier"):
     """Config after the ladder for restart ``attempt`` (1-based).
 
     Attempt 1 is a PLAIN resume — bitwise the manual kill/resume path.
     Attempt ``k >= 2`` applies stages ``0..k-2`` cumulatively (capped at
     the ladder length).  Returns ``(stage_index, new_cfg, changes)``
     where ``changes`` is ``[(stage_name, field, old, new), ...]`` and
-    ``stage_index`` is the highest rung reached (0 = none).
+    ``stage_index`` is the highest rung reached (0 = none).  ``engine``
+    suppresses rung fields the target engine cannot build (see
+    :data:`ENGINE_LADDER_EXCLUSIONS`); :func:`ladder_skips` reports
+    what was suppressed so it can be logged.
     """
     changes: List[Tuple[str, str, Any, Any]] = []
     cur = cfg
     stage_index = min(max(0, attempt - 1), len(DEGRADATION_LADDER))
     for name, build in DEGRADATION_LADDER[:stage_index]:
-        ov = build(cur)
+        ov = build(cur, engine=engine)
         if not ov:
             continue
         for field, new in sorted(ov.items()):
             changes.append((name, field, getattr(cur, field), new))
         cur = dataclasses.replace(cur, **ov)
     return stage_index, cur, changes
+
+
+def ladder_skips(cfg, attempt: int, engine: str):
+    """Rung fields suppressed for ``engine`` at restart ``attempt``.
+
+    Returns ``[(stage_name, field, reason), ...]`` — the overrides the
+    classifier ladder WOULD have applied but this engine's constructor
+    rejects.  The supervisor logs each as a ``ladder_override`` control
+    record with ``applied: false`` so a degraded CPC/VAE run's stream
+    still explains why a rung did nothing.
+    """
+    if not ENGINE_LADDER_EXCLUSIONS.get(engine, ()):
+        return []
+    skips: List[Tuple[str, str, str]] = []
+    cur = cfg          # evolves with the engine-filtered overrides that run
+    stage_index = min(max(0, attempt - 1), len(DEGRADATION_LADDER))
+    for name, build in DEGRADATION_LADDER[:stage_index]:
+        full = build(cur, engine="classifier")
+        kept = build(cur, engine=engine)
+        for field in sorted(set(full) - set(kept)):
+            skips.append((name, field,
+                          f"engine '{engine}' cannot build "
+                          f"{field}={full[field]!r}; rung field skipped"))
+        if kept:
+            cur = dataclasses.replace(cur, **kept)
+    return skips
+
+
+def ladder_records(cfg, attempt: int, *, run_id: str, ridx: int,
+                   engine: str = "classifier") -> List[Dict[str, Any]]:
+    """``ladder_override`` control records for restart ``attempt``.
+
+    Applied overrides carry from/to values; engine-suppressed rung
+    fields carry ``applied: false`` and the skip reason.  Shared by
+    :func:`supervise_classifier` and the bare-``supervise`` CPC/VAE
+    driver path so both streams explain their degradation identically.
+    """
+    stage, _, changes = ladder_overrides(cfg, attempt, engine=engine)
+    recs: List[Dict[str, Any]] = []
+    for stage_name, field, old, new in changes:
+        recs.append(dict(
+            _base_record(run_id or "unknown", ridx),
+            intervention="ladder_override", param=field,
+            from_value=old, to_value=new, scope="restart",
+            attempt=attempt, ladder_stage=stage,
+            reason=f"degradation ladder stage {stage} ({stage_name})"))
+    for stage_name, field, why in ladder_skips(cfg, attempt, engine):
+        recs.append(dict(
+            _base_record(run_id or "unknown", ridx),
+            intervention="ladder_override", param=field,
+            scope="restart", attempt=attempt, ladder_stage=stage,
+            applied=False,
+            reason=f"degradation ladder stage ({stage_name}) "
+                   f"skipped: {why}"))
+    return recs
 
 
 # -- record plumbing ---------------------------------------------------
@@ -300,8 +378,9 @@ def supervise_classifier(build_trainer, cfg, checkpoint_path: str, *,
                          run_kwargs: Optional[Dict[str, Any]] = None,
                          retry_on: Tuple = (),
                          log: Callable[[str], None] = print,
-                         sleep: Callable[[float], None] = time.sleep):
-    """Supervised classifier run with the full degradation ladder.
+                         sleep: Callable[[float], None] = time.sleep,
+                         engine: str = "classifier"):
+    """Supervised blockwise-engine run with the full degradation ladder.
 
     ``build_trainer(cfg, attempt)`` constructs the trainer for each
     attempt's (possibly degraded) config — it MUST return a fresh
@@ -309,6 +388,11 @@ def supervise_classifier(build_trainer, cfg, checkpoint_path: str, *,
     closed); the supervisor threads the ladder through
     ``dataclasses.replace`` and records every override as a
     ``ladder_override`` control record in the failed segment's stream.
+    ``engine`` makes the ladder constraint-aware: rung fields the
+    target engine cannot build are suppressed and logged with
+    ``applied: false`` instead of forced (the VAE driver passes
+    ``engine="vae"``; CPC, whose ``run`` takes no state, goes through
+    bare :func:`supervise` + :func:`ladder_records` instead).
     Returns whatever ``trainer.run`` returns.
     """
     kwargs = dict(run_kwargs or {})
@@ -320,7 +404,8 @@ def supervise_classifier(build_trainer, cfg, checkpoint_path: str, *,
             # attempt - 1.  Restart 1 resumes plain (ladder stage 0 —
             # bitwise the manual kill/resume path); the ladder engages
             # from restart 2 on.
-            stage, degraded, changes = ladder_overrides(cfg, attempt - 1)
+            stage, degraded, changes = ladder_overrides(
+                cfg, attempt - 1, engine=engine)
             box["stage"], box["cfg"] = stage, degraded
             box["changes"] = changes
         if box.get("reshape_to"):
@@ -374,16 +459,8 @@ def supervise_classifier(build_trainer, cfg, checkpoint_path: str, *,
             # `attempt` here is the restart number about to run; its
             # ladder stage is recorded against the segment that just
             # died so replay sees cause before effect
-            stage, _, changes = ladder_overrides(cfg, attempt)
-            for stage_name, field, old, new in changes:
-                extra.append(dict(
-                    _base_record(run_id or "unknown", ridx),
-                    intervention="ladder_override", param=field,
-                    from_value=old, to_value=new, scope="restart",
-                    attempt=attempt,
-                    ladder_stage=stage,
-                    reason=f"degradation ladder stage "
-                           f"{stage} ({stage_name})"))
+            extra.extend(ladder_records(
+                cfg, attempt, run_id=run_id, ridx=ridx, engine=engine))
         return jsonl_path, run_id, extra
 
     return supervise(

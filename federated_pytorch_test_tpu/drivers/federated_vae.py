@@ -27,10 +27,33 @@ def main(argv=None):
     trainer.obs_run_name = "federated_vae"
     print(f"federated_vae: K={cfg.K} devices={trainer.D} data={data.source}")
     state = common.maybe_load(trainer, "federated_vae")
+    supervised = cfg.max_restarts > 0
+    # supervision is resume-from-checkpoint: a restart budget forces the
+    # mid-run checkpoint on even without --midrun-checkpoint
     ck = (common.checkpoint_path(cfg, "federated_vae_midrun")
-          if cfg.midrun_checkpoint else None)
-    state, history = trainer.run(state, checkpoint_path=ck,
-                                 resume=cfg.load_model and ck is not None)
+          if (cfg.midrun_checkpoint or supervised) else None)
+    if supervised:
+        from federated_pytorch_test_tpu.control.supervisor import (
+            supervise_classifier,
+        )
+
+        def build_trainer(c, attempt):
+            nonlocal trainer
+            if attempt > 1:
+                # the failed attempt's trainer is closed (staging pool
+                # shut down); rebuild on the ladder-degraded config —
+                # engine="vae" keeps the ladder within what VAETrainer
+                # can construct
+                trainer = VAETrainer(AutoEncoderCNN(), c, data, FedAvg())
+                trainer.obs_run_name = "federated_vae"
+            return trainer
+
+        state, history = supervise_classifier(
+            build_trainer, cfg, ck, state=state,
+            resume=cfg.load_model, engine="vae")
+    else:
+        state, history = trainer.run(state, checkpoint_path=ck,
+                                     resume=cfg.load_model and ck is not None)
     print("Finished Training")
     common.print_obs_artifact(trainer)
     common.finish(trainer, state, "federated_vae", history)

@@ -9,10 +9,22 @@ TPU design mirrors the classifier engine: the K clients are stacked pytrees
 sharded over the 'clients' mesh axis; a round is one jitted shard_map (scan
 over Niter, vmap over local clients, psum for the average).  The host only
 feeds the [K, Niter, nbatch, 32, 32, 8] patch tensor per round.
+
+Robustness (train/rounds.py): the trainer composes the shared
+:class:`RoundKernel`, so the full fault-tolerance surface — ``fault_spec``
+injection, ``update_guard`` + quarantine, ``robust_agg`` estimators,
+``async_rounds`` bounded staleness, churn membership, simulated
+preemption, and the client-grain flight recorder — drives the same seeded
+draws and ledgers as the classifier/VAE engines.  All of it is STATIC:
+with every knob off ``_build_round`` compiles the literal pre-kernel round
+program and the trajectory is bitwise identical
+(tests/test_golden_trajectories.py).
 """
 
 from __future__ import annotations
 
+import dataclasses
+import threading
 import time
 from typing import Any, Callable, Dict, List, NamedTuple, Optional
 
@@ -51,9 +63,12 @@ from federated_pytorch_test_tpu.parallel.mesh import (
     usable_device_count,
 )
 from federated_pytorch_test_tpu.ops.infonce import info_nce_fused
+from federated_pytorch_test_tpu.train.algorithms import FedAvg
+from federated_pytorch_test_tpu.train.config import FederatedConfig
+from federated_pytorch_test_tpu.train.faults import apply_corruption
+from federated_pytorch_test_tpu.train.rounds import RoundKernel
 from federated_pytorch_test_tpu.utils import blocks as blocklib
 from federated_pytorch_test_tpu.utils import codec
-from federated_pytorch_test_tpu.obs import device_memory_stats, make_recorder
 from federated_pytorch_test_tpu.utils.profiling import profile_ctx, round_trace
 from federated_pytorch_test_tpu.utils.initializers import init_weights
 
@@ -68,8 +83,11 @@ class CPCState(NamedTuple):
     predictor: Any
 
 
-class CPCTrainer:
+class CPCTrainer(RoundKernel):
     """Rotating 3-sub-model federated CPC."""
+
+    #: engine tag in every obs record (RoundKernel contract)
+    obs_engine: str = "cpc"
 
     def __init__(self, data: CPCDataSource, latent_dim: int = 256,
                  reduced_dim: int = 32, lbfgs_history: int = 7,
@@ -78,38 +96,88 @@ class CPCTrainer:
                  sanitize: bool = False, retrace_sentinel: bool = False,
                  donate: Optional[bool] = None, cost_ledger: bool = True,
                  client_ledger: bool = True,
-                 elastic_resume: bool = False):
+                 elastic_resume: bool = False,
+                 cfg: Optional[FederatedConfig] = None):
         self.data = data
         self.K = data.K
         self.Niter = Niter
+        if cfg is None:
+            # legacy keyword surface: fold the historical per-knob kwargs
+            # into a FederatedConfig so the shared round kernel reads one
+            # config shape on every engine (robustness knobs default off)
+            cfg = FederatedConfig(
+                K=data.K, init_seed=init_seed, num_devices=num_devices,
+                sanitize=sanitize, retrace_sentinel=retrace_sentinel,
+                donate=donate, cost_ledger=cost_ledger,
+                client_ledger=client_ledger, elastic_resume=elastic_resume,
+                check_results=False)
+        else:
+            # the data source defines the federation: one client per
+            # (H5 file, SAP) pair, whatever cfg.K said
+            cfg = dataclasses.replace(cfg, K=data.K)
+        self.cfg = cfg
+        # aggregation strategy shim: CPC is FedAvg-with-writeback by
+        # construction (federated_cpc.py:289-304); the kernel reads
+        # .communicates/.name off it and the robust round aggregates
+        # through its _agg chokepoint
+        self.algo = FedAvg()
+        # classifier-engine knobs the CPC round has no program for —
+        # reject at construction rather than silently training dense
+        if cfg.compress != "none":
+            raise ValueError(
+                "the CPC engine has no compression path (--compress none "
+                "only); its wire format is the dense f32 block vector")
+        if cfg.fused_collective or cfg.sharded_update:
+            raise ValueError(
+                "fused_collective/sharded_update are classifier-engine "
+                "comm paths; the CPC round has no fused reduction")
+        if cfg.bb_update:
+            raise ValueError(
+                "bb_update is ADMM-specific (consensus rho adaptation); "
+                "the CPC round is plain FedAvg")
+        if not 0.0 < cfg.participation <= 1.0:
+            raise ValueError(
+                f"participation={cfg.participation} must be in (0, 1]")
         # mesh-reshaping resume (classifier-engine cfg.elastic_resume
         # parity): allow a checkpoint written on a different device count
         # to restage onto this mesh instead of failing geometry validation
-        self.elastic_resume = bool(elastic_resume)
+        self.elastic_resume = bool(cfg.elastic_resume)
         # buffer donation (classifier-engine parity; None = auto: on for
         # accelerator backends): the jitted round donates state/z/
         # opt_state — all rebound from its outputs — so XLA reuses the
         # buffers in place.  _run_impl deep-copies the entry state so
         # state0 (read by every later _build_round) is never donated away.
-        self._donate = (donate if donate is not None
+        self._donate = (cfg.donate if cfg.donate is not None
                         else jax.default_backend() != "cpu")
         # async checkpoint writer (utils/checkpoint.py), created by
         # _run_impl when async_checkpoint and a checkpoint path exist
         self._ckpt_writer = None
-        # observability (obs/): last RunRecorder opened by run()
+        # observability (obs/): last RunRecorder opened by run(); run()
+        # sets obs_run_name so the JSONL artifact is predictably named
         self.obs_recorder = None
+        self.obs_run_name: Optional[str] = None
+        # control-plane cfg swaps (_apply_round_control) replace the
+        # frozen cfg dataclass; the lock makes read-swap atomic
+        self._cfg_swap_lock = threading.Lock()
         # runtime sanitizers (analysis/sanitize.py, classifier-engine
         # parity): both default-off, and off means _build_round builds
         # the literal uninstrumented jax.jit(shard_map(...)) chain
-        self.sanitize = bool(sanitize)
-        self._sentinel = TraceSentinel() if retrace_sentinel else None
+        self.sanitize = bool(cfg.sanitize)
+        self._sentinel = TraceSentinel() if cfg.retrace_sentinel else None
         # device-cost ledger (obs/costs.py, classifier-engine parity):
         # default ON; None rebuilds the uninstrumented chain
-        self._ledger = CostLedger() if cost_ledger else None
-        # client-grain flight recorder (obs/clients.py, classifier-engine
-        # parity): static probe mode — off rebuilds the literal pre-probe
-        # round program
-        self._client_probe = bool(client_ledger)
+        self._ledger = CostLedger() if cfg.cost_ledger else None
+        # the shared round kernel (train/rounds.py): fault layer, robust
+        # aggregation hook, and every host-side round ledger
+        self._init_round_kernel()
+        self._validate_round_cfg()
+        # static robust-round flag: when False, _build_round compiles the
+        # LITERAL pre-kernel round program (bitwise-identity contract);
+        # when True it builds the masked/guarded/robust variant
+        self._robust_round = (self.faults.enabled
+                              or cfg.participation < 1.0
+                              or cfg.async_rounds or cfg.update_guard
+                              or cfg.robust_agg != "none")
         self.models = {
             "encoder": EncoderCNN(latent_dim=latent_dim),
             "contextgen": ContextgenCNN(latent_dim=latent_dim),
@@ -123,15 +191,17 @@ class CPCTrainer:
         # `is None`, not `or`: an explicit 0 must reach client_mesh's
         # validation instead of silently selecting the auto default
         mesh = client_mesh(usable_device_count(self.K)
-                           if num_devices is None else num_devices)
+                           if cfg.num_devices is None else cfg.num_devices)
         self.mesh = mesh
         self.D = mesh.devices.size
         if self.K % self.D:
             raise ValueError(f"K={self.K} not divisible by {self.D} devices")
+        # the kernel's per-run constant masks, staged once over this mesh
+        self._stage_round_constants()
 
         # common init (reference seeds all K identically,
         # federated_cpc.py:184-189)
-        rng = jax.random.PRNGKey(init_seed)
+        rng = jax.random.PRNGKey(cfg.init_seed)
         ps = data.patch_size
         sample = jnp.zeros((1, ps, ps, 8), jnp.float32)
         enc_p, _ = self.models["encoder"].init_variables(rng, sample)
@@ -154,6 +224,10 @@ class CPCTrainer:
         self.state0 = CPCState(**{k: stage_tree_global(stack(v), csh)
                                   for k, v in params.items()})
         self._fn_cache: Dict[Any, Any] = {}
+        # (px, py) of the round in flight: _save_midrun records it so a
+        # resumed run rebuilds the identical jitted round (the kernel's
+        # _health_abort drives _save_midrun without round-local scope)
+        self._cur_pxpy = (0, 0)
 
     # ------------------------------------------------------------------
     # The reference closure runs encoder -> contextgen -> predictor ->
@@ -187,17 +261,23 @@ class CPCTrainer:
         """Contextgen -> predictor -> InfoNCE on a latent grid."""
         return self._predict_loss(pred_p, grid, self._context(ctx_p, grid))
 
-    @staticmethod
-    def _obs_sync(obs, *values):
-        """Drain async dispatch at an obs phase-timing boundary
-        (graftcheck JG104) so stage_seconds measures staging execution,
-        not dispatch, when obs is recording; no-op with obs off."""
-        if obs.enabled:
-            jax.block_until_ready([v for v in values if v is not None])
+    def round_bytes_on_wire(self, N: int, n_active) -> int:
+        """Dense f32 block payload from each of ``n_active`` clients
+        (CPC has no compression path; kernel wire-byte contract)."""
+        return 4 * N * int(n_active)
 
     def _build_round(self, mdl: str, ci: int, px: int, py: int):
         """Jitted (train Niter batches + fedavg + writeback) for one
-        (sub-model, block)."""
+        (sub-model, block).
+
+        Default (``_robust_round`` False): the literal pre-kernel
+        program — ``fn(state, z, opt_state, data)``.  Robust: the masked
+        variant ``fn(state, z, opt_state, data, tmask, wmask, corrupt,
+        gbound)`` mirroring the classifier comm stage: straggler/async
+        select on the trained block, wire corruption at the encode
+        boundary, update guard, robust/weighted aggregation through the
+        algorithm's ``_agg`` chokepoint, masked write-back.
+        """
         key = (mdl, ci, px, py)
         if key in self._fn_cache:
             return self._fn_cache[key]
@@ -258,22 +338,24 @@ class CPCTrainer:
 
         sanitize = self.sanitize
         client_probe = self._client_probe
-        if client_probe:
+        robust = self._robust_round
+        guard_on = self.cfg.update_guard
+        has_corrupt = self.faults.enabled and self.faults.corrupt > 0
+        corrupt_mode, corrupt_scale = self.faults.mode, self.faults.scale
+        mean_fn = self.mean_fn
+        algo = self.algo
+        if client_probe or robust:
             from federated_pytorch_test_tpu.parallel.comm import (
                 per_client_norms,
             )
 
-        def round_shard(state: CPCState, z, opt_state, data):
-            # data: [K_local, Niter, nbatch, ps, ps, 8]
-            # opt_state persists across Nadmm rounds — the reference creates
-            # the optimizer once per (sub-model, block) BEFORE the nadmm loop
-            # (federated_cpc.py:241-252), so curvature history carries over
+        def _train_all(state: CPCState, opt_state, data):
+            """vmapped local training over all stacked clients; under
+            --sanitize, vmap-of-checkify (the LBFGS line search is a
+            lax.while_loop per client and checkify cannot instrument a
+            batched while; carrying the batched Error out as an extra
+            leading output is the supported nesting)."""
             if sanitize:
-                # the LBFGS line search is a lax.while_loop per client and
-                # checkify cannot instrument a batched while (checkify-of-
-                # vmap-of-while is rejected); nest the supported way —
-                # vmap-of-checkify — and carry the batched Error out as an
-                # extra leading output for the host-side throw
                 from jax.experimental import checkify
 
                 checked = checkify.checkify(per_client,
@@ -286,6 +368,15 @@ class CPCTrainer:
                 xflat, opt_state, losses = jax.vmap(per_client)(
                     state.encoder, state.contextgen, state.predictor,
                     opt_state, data)
+            return errk, xflat, opt_state, losses
+
+        def round_shard(state: CPCState, z, opt_state, data):
+            # data: [K_local, Niter, nbatch, ps, ps, 8]
+            # opt_state persists across Nadmm rounds — the reference creates
+            # the optimizer once per (sub-model, block) BEFORE the nadmm loop
+            # (federated_cpc.py:241-252), so curvature history carries over
+            errk, xflat, opt_state, losses = _train_all(state, opt_state,
+                                                        data)
             znew = federated_mean(xflat, K)               # fedavg (:289-296)
             dual = jnp.linalg.norm(z - znew) / N          # (:295)
             sub = getattr(state, mdl)
@@ -301,6 +392,101 @@ class CPCTrainer:
                              per_client_norms(xflat, znew))
             return (errk, out) if sanitize else out
 
+        def _sel(m, new, old):
+            """Per-client where-select over stacked leaves (m [K_local])."""
+            mm = m.reshape((-1,) + (1,) * (new.ndim - 1))
+            return jnp.where(mm > 0, new, old)
+
+        def round_shard_robust(state: CPCState, z, opt_state, data,
+                               tmask, wmask, corrupt, gbound):
+            # the robust round (classifier comm-stage parity): every
+            # client trains, then static where-selects compose the
+            # round's activity — compute-then-select keeps the program
+            # shape uniform so one compile serves every mask draw
+            errk, xflat_t, opt_t, losses = _train_all(state, opt_state,
+                                                      data)
+            sub = getattr(state, mdl)
+            xflat0 = jax.vmap(
+                lambda p: codec.get_trainable_values(p, order, mask))(sub)
+            # stragglers (and async non-dispatchers) withhold the promised
+            # update: they ship — and keep — their round-start block, and
+            # their LBFGS curvature history stays bit-untouched
+            xflat = jnp.where(tmask[:, None] > 0, xflat_t, xflat0)
+            opt_state = jax.tree.map(
+                lambda nw, od: _sel(tmask, nw, od), opt_t, opt_state)
+            losses = losses * tmask
+            x = xflat
+            if has_corrupt:
+                # fault injection at the encode(x_k - z) boundary, exactly
+                # where a faulty client poisons a real deployment
+                # (classifier-engine comm_shard parity)
+                x = z[None, :] + apply_corruption(
+                    x - z[None, :], corrupt, corrupt_mode, corrupt_scale,
+                    w=wmask, axis_name=CLIENT_AXIS)
+            cl_nrm = None
+            if client_probe:
+                # raw pre-guard ||x_k - z||: a NaN/inf delta stays visible
+                # here even though the guard rewrites the row to z
+                cl_nrm = per_client_norms(x, z)
+            w = wmask
+            okf = None
+            if guard_on:
+                # update guards (classifier parity): finite + norm-bounded
+                # or masked out exactly like a non-participant.  NaN
+                # hygiene: where-selects only — masks are never multiplied
+                # into possibly-corrupt rows.
+                d = x - z[None, :]
+                finite = jax.vmap(lambda v: jnp.all(jnp.isfinite(v)))(d)
+                nrm = jax.vmap(jnp.linalg.norm)(
+                    jnp.where(finite[:, None], d, 0.0))
+                okf = (finite & (nrm <= gbound)).astype(jnp.float32)
+                w = wmask * okf
+                n_ok = lax.psum(jnp.sum(w), CLIENT_AXIS)
+                n_trip = lax.psum(jnp.sum(wmask * (1.0 - okf)),
+                                  CLIENT_AXIS)
+                norm_mean = lax.psum(jnp.sum(w * nrm), CLIENT_AXIS) \
+                    / jnp.maximum(n_ok, 1.0)
+                x = jnp.where(okf[:, None] > 0, x, z[None, :])
+            # the one aggregation chokepoint every engine shares
+            # (algorithms._agg): robust estimator when cfg.robust_agg,
+            # weighted active mean otherwise
+            znew, _, adiag = algo.global_update(
+                x, z, z, jnp.float32(0.0), K, w=w, mean_fn=mean_fn)
+            dual = adiag.pop("dual_residual")
+            if guard_on:
+                # all-rejected round degrades gracefully: z carries over
+                znew = jnp.where(n_ok > 0, znew, z)
+                adiag["guard_trips"] = n_trip
+                adiag["guard_norm_mean"] = norm_mean
+                adiag["n_ok"] = n_ok
+            cl_dist = None
+            if client_probe:
+                cl_dist = per_client_norms(x, znew)
+            # write-back: the round's participants receive z_new;
+            # trained-but-undelivered clients (async dispatchers) keep the
+            # freshly trained block — their frozen params ARE the
+            # in-flight buffer; everyone else keeps the round-start block.
+            # Guard-rejected clients do NOT receive z (w, not wmask):
+            # quarantine keeps them out until they re-qualify.
+            own = jax.vmap(
+                lambda p, v: codec.put_trainable_values(p, order, mask, v)
+            )(sub, xflat)
+            wrote = jax.vmap(
+                lambda p: codec.put_trainable_values(p, order, mask, znew)
+            )(own)
+            sub_new = jax.tree.map(
+                lambda nw, od: _sel(w, nw, od), wrote, own)
+            adiag["n_active"] = lax.psum(jnp.sum(wmask), CLIENT_AXIS)
+            out = (state._replace(**{mdl: sub_new}), znew, opt_state,
+                   dual, losses, adiag)
+            if client_probe:
+                out = out + (cl_nrm, cl_dist)
+            if guard_on:
+                # okf rides back to the host so the round loop can
+                # quarantine the offenders it names
+                out = out + (okf,)
+            return (errk, out) if sanitize else out
+
         def init_opt(state: CPCState):
             sub = getattr(state, mdl)
             return jax.vmap(
@@ -310,21 +496,36 @@ class CPCTrainer:
         spec_c = P(CLIENT_AXIS)
         spec_r = P()
         state_spec = CPCState(spec_c, spec_c, spec_c)
-        out_specs = (state_spec, spec_r, spec_c, spec_r, spec_c)
-        if client_probe:
-            out_specs = out_specs + (spec_c, spec_c)   # cl_nrm, cl_dist
+        if robust:
+            diag_keys = ("n_active",) + (
+                ("guard_trips", "guard_norm_mean", "n_ok")
+                if guard_on else ())
+            out_specs = (state_spec, spec_r, spec_c, spec_r, spec_c,
+                         {k: spec_r for k in diag_keys})
+            if client_probe:
+                out_specs = out_specs + (spec_c, spec_c)  # cl_nrm, cl_dist
+            if guard_on:
+                out_specs = out_specs + (spec_c,)         # okf verdicts
+            in_specs = (state_spec, spec_r, spec_c, spec_c,
+                        spec_c, spec_c, spec_c, spec_r)
+            body = round_shard_robust
+        else:
+            out_specs = (state_spec, spec_r, spec_c, spec_r, spec_c)
+            if client_probe:
+                out_specs = out_specs + (spec_c, spec_c)  # cl_nrm, cl_dist
+            in_specs = (state_spec, spec_r, spec_c, spec_c)
+            body = round_shard
         if self.sanitize:
-            # checkify already happened inside round_shard (vmap-of-
+            # checkify already happened inside the round body (vmap-of-
             # checkify, see above), so instrument with sanitize=False and
             # throw the per-client batched Error on the host ourselves;
             # spec_c as a tree prefix shards every error leaf by client
             out_specs = (spec_c, out_specs)
-        inner = shard_map(round_shard, mesh=self.mesh,
-                          in_specs=(state_spec, spec_r, spec_c, spec_c),
+        inner = shard_map(body, mesh=self.mesh, in_specs=in_specs,
                           out_specs=out_specs, check_vma=False)
         # donate state/z/opt_state (argnums 0-2): the round loop rebinds
-        # all three from the outputs; the staged data (argnum 3) is fresh
-        # every round and left alone
+        # all three from the outputs; the staged data (argnum 3) and the
+        # per-round mask/bound operands are fresh or reused and left alone
         fn = instrument_jit(inner, f"round[{mdl},blk={ci},{px}x{py}]",
                             sanitize=False, sentinel=self._sentinel,
                             ledger=self._ledger,
@@ -345,15 +546,16 @@ class CPCTrainer:
     # which only restarts from its end-of-run encoder<k>.model files,
     # federated_cpc.py:126-134)
     # ------------------------------------------------------------------
-    def _save_midrun(self, path, state: CPCState, z, opt_state, px, py,
-                     nxt, history) -> None:
+    def _save_midrun(self, path, state: CPCState, blockvars, nxt,
+                     history) -> None:
         from federated_pytorch_test_tpu.utils.checkpoint import (
-            mesh_geometry_meta,
             pack_history,
             save_checkpoint_swapped,
             snapshot_to_host,
         )
 
+        z, opt_state = blockvars
+        px, py = self._cur_pxpy
         nloop, mdl_i, ci, nadmm = nxt
         mid_block = nadmm > 0       # z + LBFGS state carry over mid-block
         tree = dict(state._asdict())
@@ -374,10 +576,10 @@ class CPCTrainer:
             "data_round": len(history),
             "history": pack_history(history),
         }
-        # geometry stamp (classifier-engine parity): every slot knows the
-        # mesh that wrote it, so resume validates before any device_put
-        meta.update(mesh_geometry_meta(
-            devices=self.D, processes=jax.process_count(), K=self.K))
+        # geometry stamp + churn/guard/async ledgers (RoundKernel): every
+        # slot knows the mesh that wrote it and the host robustness state
+        # the resumed run must replay
+        meta.update(self._ledger_meta())
         if self._ckpt_writer is not None:
             # async: materialize a host copy first (donation-safe — the
             # device buffers may be reused by the next round's dispatch),
@@ -424,6 +626,9 @@ class CPCTrainer:
                                jax.eval_shape(init_fn, state)), csh)
             z = stage_global(np.asarray(tree["z"], np.float32),
                              replicated_sharding(self.mesh))
+        # kernel ledgers (quarantine / guard scale / async buffer / churn
+        # membership) restore with predates-fallbacks (RoundKernel)
+        self._restore_ledger_meta(meta)
         history = unpack_history(meta["history"])
         nxt = (int(meta["nloop"]), int(meta["mdl_i"]), int(meta["ci"]),
                int(meta["nadmm"]), mid)
@@ -479,7 +684,14 @@ class CPCTrainer:
         the round stream: "off" | "warn" (default) | "abort" |
         "checkpoint-abort" (same contract as the classifier engine's
         ``--health-action``; with no ``checkpoint_path`` a
-        checkpoint-abort trip degrades to a plain abort).
+        checkpoint-abort trip saves a one-off
+        ``<checkpoint_dir>/<run_name>_health_abort`` slot first,
+        classifier-engine parity).
+
+        The robustness knobs themselves (fault spec, guards, robust
+        aggregation, async staleness, control plane) are CONSTRUCTION
+        state — pass a :class:`FederatedConfig` via ``cfg=`` to
+        ``__init__``; this method only carries the per-run plumbing.
         """
         with profile_ctx(profile_dir):
             return self._run_impl(Nloop, Nadmm, state, log, prefetch,
@@ -495,22 +707,24 @@ class CPCTrainer:
                   profile_on=False,
                   obs_dir=None, obs_sinks="auto", obs_run_name="cpc_admm",
                   health_action="warn"):
-        from federated_pytorch_test_tpu.obs.health import (
-            HEALTH_ACTIONS,
-            HealthMonitor,
-            RunHealthAbort,
-        )
+        from federated_pytorch_test_tpu.obs.health import HEALTH_ACTIONS
         from federated_pytorch_test_tpu.utils.checkpoint import (
             CheckpointCorruptError,
             CheckpointGeometryError,
             checkpoint_slots,
-            finalize_checkpoint,
             verify_checkpoint,
         )
 
         if health_action not in HEALTH_ACTIONS:
             raise ValueError(f"health_action={health_action!r} must be one "
                              f"of {HEALTH_ACTIONS}")
+        # fold the per-run plumbing into the shared config so the kernel's
+        # obs/health/control wiring reads one source of truth
+        self.cfg = dataclasses.replace(
+            self.cfg, Nloop=Nloop, Nadmm=Nadmm, prefetch=bool(prefetch),
+            obs_dir=obs_dir, obs_sinks=obs_sinks,
+            health_action=health_action)
+        self.obs_run_name = obs_run_name
 
         state = state or self.state0
         if self._donate:
@@ -519,7 +733,6 @@ class CPCTrainer:
             # state0 for mask/size templates all run long
             state = jax.tree.map(jnp.copy, state)
         history: List[Dict[str, Any]] = []
-        csh = client_sharding(self.mesh)
         rows = local_client_rows(self.mesh, self.K)
 
         resume_at = r_z = r_opt = None
@@ -550,6 +763,9 @@ class CPCTrainer:
                 raise CheckpointCorruptError(
                     "no valid mid-run checkpoint slot survives: "
                     + "; ".join(failures))
+        # simulated preemption is one-shot per segment: a resumed segment
+        # replaying the drawn round must not re-fire it (RoundKernel)
+        self._preempt_armed = resume_at is None
 
         # size the producer by walking the ACTUAL remaining loop structure
         # (not total - len(history): a resume under a different
@@ -584,17 +800,16 @@ class CPCTrainer:
                     "to synchronous checkpointing")
             else:
                 self._ckpt_writer = AsyncCheckpointWriter()
-        obs = make_recorder(obs_sinks, obs_dir, run_name=obs_run_name,
-                            engine="cpc", algorithm="fedavg")
-        obs.open(config={"Nloop": Nloop, "Nadmm": Nadmm,
-                         "Niter": self.Niter, "K": self.K,
-                         "prefetch": bool(prefetch)},
-                 mesh_shape=dict(self.mesh.shape), resumed=restored,
-                 rounds_prior=len(history))
-        if health_action != "off":
-            obs.attach_health(HealthMonitor(action=health_action,
-                                            n_clients=self.K))
-        self.obs_recorder = obs
+        # shared obs wiring (RoundKernel._open_obs): recorder + health
+        # watchdog + closed-loop controller, identical to the classifier
+        obs = self._open_obs(resumed=restored, rounds_prior=len(history))
+        if obs.control is not None:
+            obs.control.can_restart = checkpoint_path is not None
+        # cumulative block offset across the sub-model rotation: the
+        # kernel's seeded draws key on (nloop, block, nadmm), and two
+        # blocks of different sub-models must never share a draw
+        blocks_per = [len(self.models[m].train_order_block_ids())
+                      for m in SUBMODELS]
         try:
             for nloop in range(Nloop):
                 for mdl_i, mdl in enumerate(SUBMODELS):
@@ -603,188 +818,31 @@ class CPCTrainer:
                         pos = (nloop, mdl_i, ci)
                         if resume_at is not None and pos < resume_at[:3]:
                             continue
+                        flat_bi = sum(blocks_per[:mdl_i]) + ci
                         z = opt_state = None
                         nadmm_start = 0
                         if (resume_at is not None and pos == resume_at[:3]
                                 and resume_at[4]):
                             z, opt_state = r_z, r_opt
                             nadmm_start = resume_at[3]
+                        else:
+                            # fresh block: recalibrate the guard scale and
+                            # void in-flight async updates (RoundKernel);
+                            # a mid-block resume restored the ledgers from
+                            # the checkpoint meta instead
+                            self._reset_block_ledgers()
                         resume_at = None
                         for nadmm in range(nadmm_start, Nadmm):
                             # one XProf step per round, keyed on the global
                             # round index == the obs round_index (classifier-
                             # engine parity: utils/profiling.round_trace)
+                            box = [state, z, opt_state]
                             with round_trace(len(history), enabled=profile_on):
-                                t_round = time.perf_counter()
-                                px, py, batch = (
-                                    src.get() if src is not None
-                                    else self.data.round_batches(self.Niter,
-                                                                 clients=rows))
-                                fn, init_fn, N = self._build_round(mdl, ci, px,
-                                                                   py)
-                                if z is None:
-                                    z = stage_global(
-                                        np.zeros((N,), np.float32),
-                                        replicated_sharding(self.mesh))
-                                    opt_state = init_fn(state)
-                                staged = stage_client_rows(batch, csh)
-                                # with obs recording, stage_seconds must
-                                # cover the H2D copy's execution, not just
-                                # its dispatch (graftcheck JG104)
-                                self._obs_sync(obs, staged)
-                                t_staged = time.perf_counter()
-                                out = fn(state, z, opt_state, staged)
-                                cl_nrm = cl_dist = None
-                                if self._client_probe:
-                                    cl_nrm, cl_dist = out[-2], out[-1]
-                                    out = out[:-2]
-                                state, z, opt_state, dual, losses = out
-                                loss_host = np.asarray(fetch(losses))
-                                rec = dict(nloop=nloop, model=mdl, block=ci,
-                                           nadmm=nadmm, N=N,
-                                           # the whole round is one jitted
-                                           # dispatch by construction here
-                                           host_dispatches=1,
-                                           dual_residual=float(dual),
-                                           loss=float(np.sum(loss_host)),
-                                           # dense f32 block payload from all
-                                           # K clients (schema parity with
-                                           # the classifier engine; CPC has
-                                           # no compression path yet)
-                                           bytes_on_wire=4 * N * self.K)
-                                # the float()/fetch above force a device sync,
-                                # so the stage/compute split is honest
-                                t_done = time.perf_counter()
-                                rec["stage_seconds"] = t_staged - t_round
-                                rec["compute_seconds"] = t_done - t_staged
-                                rec["round_seconds"] = t_done - t_round
-                                if self._sentinel is not None:
-                                    rec["jit_retraces"] = \
-                                        self._sentinel.retraces
-                                ledger_events = ()
-                                if self._ledger is not None:
-                                    rcosts = self._ledger.drain()
-                                    ledger_events = rcosts.events
-                                    rec.update(round_cost_fields(
-                                        rcosts, t_round,
-                                        rec["round_seconds"]))
-                                history.append(rec)
-                                if checkpoint_path is not None:
-                                    if nadmm + 1 < Nadmm:
-                                        nxt = (nloop, mdl_i, ci, nadmm + 1)
-                                    elif ci + 1 < len(blocks):
-                                        nxt = (nloop, mdl_i, ci + 1, 0)
-                                    elif mdl_i + 1 < len(SUBMODELS):
-                                        nxt = (nloop, mdl_i + 1, 0, 0)
-                                    else:
-                                        nxt = (nloop + 1, 0, 0, 0)
-                                    # timed so async-vs-sync shows up in the
-                                    # record: async = snapshot + enqueue
-                                    # only; the sync save's np.asarray is
-                                    # its own device sync, so no explicit
-                                    # block is wanted in this region
-                                    t_ckpt = time.perf_counter()  # graftlint: disable=JG104
-                                    self._save_midrun(checkpoint_path, state, z,
-                                                      opt_state, px, py, nxt,
-                                                      history)
-                                    rec["ckpt_write_seconds"] = (
-                                        time.perf_counter() - t_ckpt)
-                                if obs.enabled or obs.health is not None:
-                                    ridx = len(history) - 1
-                                    rrec = obs.round(dict(
-                                        rec, round_index=ridx,
-                                        bytes_dense=4 * N * self.K,
-                                        t_start=t_round,
-                                        **device_memory_stats()))
-                                    if self._client_probe:
-                                        # client flight-recorder line
-                                        # (schema v10, obs/clients.py):
-                                        # CPC is full-participation with
-                                        # a dense f32 block payload
-                                        from federated_pytorch_test_tpu\
-                                            .obs.clients import (
-                                                client_round_fields,
-                                            )
-                                        ones = np.ones(self.K, np.float32)
-                                        obs.client_event(client_round_fields(
-                                            ridx, self.K,
-                                            update_norm=np.asarray(
-                                                fetch(cl_nrm)),
-                                            dist_z=np.asarray(
-                                                fetch(cl_dist)),
-                                            loss=loss_host,
-                                            active=ones, weight=ones,
-                                            payload_bytes=4 * N))
-                                    if obs.enabled:
-                                        rspan = (rrec or {}).get("span_id")
-                                        obs.span("stage", t_round, t_staged,
-                                                 cat="phase", round_index=ridx,
-                                                 parent_span=rspan)
-                                        obs.span("compute", t_staged, t_done,
-                                                 cat="phase", round_index=ridx,
-                                                 parent_span=rspan)
-                                        if "ckpt_write_seconds" in rec:
-                                            # after t_done: hangs off the
-                                            # RUN span (laminar nesting)
-                                            obs.span(
-                                                "ckpt", t_ckpt, t_ckpt
-                                                + rec["ckpt_write_seconds"],
-                                                cat="ckpt", round_index=ridx)
-                                        t_hi = (t_round
-                                                + rec["round_seconds"] + 1e-9)
-                                        for cev in ledger_events:
-                                            in_rnd = (
-                                                rspan is not None
-                                                and cev.t_start
-                                                >= t_round - 1e-9
-                                                and cev.t_end <= t_hi)
-                                            obs.compile_event(
-                                                cev.record(round_index=ridx),
-                                                parent_span=(rspan if in_rnd
-                                                             else None))
-                                    if (obs.health is not None
-                                            and obs.health.tripped
-                                            is not None):
-                                        alert = obs.health.tripped
-                                        log(f"health: rule "
-                                            f"{alert.get('rule')!r} tripped "
-                                            f"on round "
-                                            f"{alert.get('round_index')} "
-                                            f"(action={obs.health.action})")
-                                        if (obs.health.action
-                                                == "checkpoint-abort"
-                                                and checkpoint_path
-                                                is not None):
-                                            # this round already saved; just
-                                            # drain the writer and verify.
-                                            # No slot at all (failed async
-                                            # save) degrades to a plain
-                                            # abort — the health alert must
-                                            # surface, not a secondary
-                                            # checkpoint error
-                                            from federated_pytorch_test_tpu\
-                                                .utils.checkpoint import (
-                                                NoUsableCheckpointError,
-                                            )
-                                            self._flush_ckpt_writer()
-                                            try:
-                                                slot = finalize_checkpoint(
-                                                    checkpoint_path)
-                                            except NoUsableCheckpointError \
-                                                    as e:
-                                                log("WARNING: health: no "
-                                                    "usable checkpoint to "
-                                                    f"finalize ({e}); "
-                                                    "aborting without one")
-                                            else:
-                                                log("health: final "
-                                                    "checkpoint verified "
-                                                    f"at {slot}")
-                                        raise RunHealthAbort(alert)
-                                log(f"dual (N={N},loop={nloop},model={mdl},"
-                                    f"block={ci},avg={nadmm})="
-                                    f"{rec['dual_residual']:e} "
-                                    f"loss={rec['loss']:e}")
+                                self._step_round(
+                                    obs, src, box, nloop, mdl_i, mdl, ci,
+                                    flat_bi, nadmm, Nadmm, blocks, history,
+                                    checkpoint_path, log)
+                            state, z, opt_state = box
         except BaseException:
             try:                     # abort path: the original error wins
                 self._flush_ckpt_writer()
@@ -800,3 +858,162 @@ class CPCTrainer:
         # background failure raised) before the run reports success
         self._flush_ckpt_writer()
         return state, history
+
+    def _step_round(self, obs, src, box, nloop, mdl_i, mdl,
+                    ci, flat_bi, nadmm, Nadmm, blocks, history,
+                    checkpoint_path, log):
+        """One communication round of the rotation (hoisted out of the
+        quadruple loop nest for readability; ``box`` is the in/out
+        [state, z, opt_state] cell for the rebound round variables)."""
+        state, z, opt_state = box
+        cfg = self.cfg
+        t_round = time.perf_counter()
+        # simulated preemption fires BEFORE any work this round, at the
+        # same boundary the classifier engine uses
+        self._maybe_preempt(nloop, flat_bi, nadmm, len(history),
+                            checkpoint_path)
+        px, py, batch = (src.get() if src is not None
+                         else self.data.round_batches(self.Niter,
+                                                      clients=self._rows()))
+        self._cur_pxpy = (px, py)
+        fn, init_fn, N = self._build_round(mdl, ci, px, py)
+        if z is None:
+            z = stage_global(np.zeros((N,), np.float32),
+                             replicated_sharding(self.mesh))
+            opt_state = init_fn(state)
+        # round-start quarantine census (the record's `quarantined` field,
+        # classifier parity) and the round's activity masks.  The fast
+        # path with every knob off returns the staged constants and an
+        # empty counts dict — and stashes the client-ledger arrays the
+        # kernel's emitter reads.
+        q_start = int(np.sum(self._quarantine > 0))
+        tmask, wmask, corruptv, comm_host, fcounts = \
+            self._round_activity(nloop, flat_bi, nadmm)
+        n_comm = fcounts.pop("n_comm", 1)
+        staged = stage_client_rows(batch, client_sharding(self.mesh))
+        # with obs recording, stage_seconds must cover the H2D copy's
+        # execution, not just its dispatch (graftcheck JG104)
+        self._obs_sync(obs, staged)
+        t_staged = time.perf_counter()
+        cl_nrm = cl_dist = None
+        diag: Dict[str, float] = {}
+        if self._robust_round and n_comm == 0:
+            # every client dropped/quarantined/in-flight: no exchange, no
+            # training dispatch; z and the sub-model carry over unchanged
+            # (classifier all-dropped parity) and quarantine still ticks
+            dual = 0.0
+            loss_host = None
+            diag = {"n_active": 0.0}
+            if cfg.update_guard:
+                diag.update(guard_trips=0.0, n_ok=0.0)
+                self._quarantine = np.maximum(self._quarantine - 1, 0)
+            dispatches = 0
+        elif self._robust_round:
+            out = fn(state, z, opt_state, staged, tmask, wmask, corruptv,
+                     self._round_gbound())
+            okf = None
+            if cfg.update_guard:
+                okf = out[-1]
+                out = out[:-1]
+            if self._client_probe:
+                cl_nrm, cl_dist = out[-2], out[-1]
+                out = out[:-2]
+            state, z, opt_state, dual, losses, diag_dev = out
+            diag = {k: float(fetch(v)) for k, v in diag_dev.items()}
+            if cfg.update_guard:
+                self._apply_guard_verdicts(diag, okf, comm_host)
+            loss_host = np.asarray(fetch(losses))
+            dispatches = 1
+        else:
+            # every knob off: the literal pre-kernel dispatch
+            out = fn(state, z, opt_state, staged)
+            if self._client_probe:
+                cl_nrm, cl_dist = out[-2], out[-1]
+                out = out[:-2]
+            state, z, opt_state, dual, losses = out
+            loss_host = np.asarray(fetch(losses))
+            dispatches = 1
+        if cl_nrm is not None:
+            cl_nrm = np.asarray(fetch(cl_nrm))
+            cl_dist = np.asarray(fetch(cl_dist))
+        rec = dict(nloop=nloop, model=mdl, block=ci, nadmm=nadmm, N=N,
+                   # the whole round is one jitted dispatch by
+                   # construction here (0 on an all-dropped skip)
+                   host_dispatches=dispatches,
+                   dual_residual=float(dual),
+                   loss=(float(np.sum(loss_host))
+                         if loss_host is not None else 0.0),
+                   # dense f32 block payload (schema parity with the
+                   # classifier engine; CPC has no compression path) —
+                   # from the round's participants under the robust
+                   # masks, from all K on the reference path
+                   bytes_on_wire=(
+                       self.round_bytes_on_wire(
+                           N, diag.get("n_active", self.K))
+                       if self._robust_round else 4 * N * self.K))
+        rec.update(fcounts)
+        rec.update(diag)
+        if self._robust_round and cfg.update_guard:
+            rec["quarantined"] = q_start
+        # the float()/fetch above force a device sync, so the
+        # stage/compute split is honest
+        t_done = time.perf_counter()
+        rec["stage_seconds"] = t_staged - t_round
+        rec["compute_seconds"] = t_done - t_staged
+        rec["round_seconds"] = t_done - t_round
+        if self._sentinel is not None:
+            rec["jit_retraces"] = self._sentinel.retraces
+        ledger_events = ()
+        if self._ledger is not None:
+            rcosts = self._ledger.drain()
+            ledger_events = rcosts.events
+            rec.update(round_cost_fields(rcosts, t_round,
+                                         rec["round_seconds"]))
+        history.append(rec)
+        if nadmm + 1 < Nadmm:
+            nxt = (nloop, mdl_i, ci, nadmm + 1)
+        elif ci + 1 < len(blocks):
+            nxt = (nloop, mdl_i, ci + 1, 0)
+        elif mdl_i + 1 < len(SUBMODELS):
+            nxt = (nloop, mdl_i + 1, 0, 0)
+        else:
+            nxt = (nloop + 1, 0, 0, 0)
+        t_ckpt = None
+        if checkpoint_path is not None:
+            # timed so async-vs-sync shows up in the record: async =
+            # snapshot + enqueue only; the sync save's np.asarray is its
+            # own device sync, so no explicit block is wanted here
+            t_ckpt = time.perf_counter()  # graftlint: disable=JG104
+            self._save_midrun(checkpoint_path, state, (z, opt_state),
+                              nxt, history)
+            rec["ckpt_write_seconds"] = time.perf_counter() - t_ckpt
+        extra_fields = {"bytes_dense": (
+            4 * N * int(diag.get("n_active", self.K))
+            if self._robust_round else 4 * N * self.K)}
+        if cfg.async_rounds:
+            extra_fields["async_mode"] = True
+            # self.cfg, not a snapshot: a round-scope control
+            # intervention may have moved the cutoff live
+            extra_fields["max_staleness"] = self.cfg.max_staleness
+        # shared observability fan-out (RoundKernel): round record +
+        # client flight-recorder line + spans + health/control checks
+        self._emit_round_obs(
+            obs, rec, round_index=len(history) - 1, t_round=t_round,
+            extra_fields=extra_fields, N=N, loss_host=loss_host,
+            cl_nrm=cl_nrm, cl_dist=cl_dist,
+            phase_marks=[("stage", "phase", t_round, t_staged),
+                         ("compute", "phase", t_staged, t_done)],
+            t_ckpt=t_ckpt, ledger_events=ledger_events,
+            checkpoint_path=checkpoint_path, state=state,
+            blockvars=(z, opt_state), nxt=nxt, history=history, log=log)
+        log(f"dual (N={N},loop={nloop},model={mdl},"
+            f"block={ci},avg={nadmm})="
+            f"{rec['dual_residual']:e} "
+            f"loss={rec['loss']:e}")
+        box[0] = state
+        box[1] = z
+        box[2] = opt_state
+
+    def _rows(self):
+        """Addressable client rows of this process (multi-host)."""
+        return local_client_rows(self.mesh, self.K)

@@ -40,6 +40,8 @@ from federated_pytorch_test_tpu.control.replay import (
 from federated_pytorch_test_tpu.control.supervisor import (
     RestartBudgetExhausted,
     ladder_overrides,
+    ladder_records,
+    ladder_skips,
     restart_backoff_seconds,
     supervise,
     supervise_classifier,
@@ -801,3 +803,117 @@ class TestChaosAcceptance:
              "reason": "forged"},
         ])
         assert errors and stats["segments"] == 1
+
+
+# ----------------------------------------------------------------------
+# engine-aware degradation ladder (ISSUE 15): CPC/VAE parametrizations
+
+
+class TestEngineAwareLadder:
+    def test_vae_ladder_is_the_classifier_ladder(self):
+        # VAE shares the full blockwise feature set: no exclusions, no
+        # skips — byte-identical ladder outcome at every attempt
+        cfg = small_cfg()
+        for attempt in range(1, 6):
+            assert (ladder_overrides(cfg, attempt, engine="vae")
+                    == ladder_overrides(cfg, attempt))
+            assert ladder_skips(cfg, attempt, "vae") == []
+
+    def test_cpc_ladder_suppresses_compress_only(self):
+        cfg = small_cfg()
+        _, c2, ch2 = ladder_overrides(cfg, 2, engine="cpc")
+        assert {(s, f) for s, f, _, _ in ch2} == {
+            ("shield", "update_guard"), ("shield", "quarantine_rounds")}
+        assert c2.compress == "none"              # CPC has no compress path
+        assert c2.update_guard is True
+        skips = ladder_skips(cfg, 2, "cpc")
+        assert [(s, f) for s, f, _ in skips] == [("shield", "compress")]
+        assert "cpc" in skips[0][2]
+        # later rungs are unaffected: median + reduced cohort still land
+        _, c4, _ = ladder_overrides(cfg, 4, engine="cpc")
+        assert c4.robust_agg == "median"
+        assert c4.participation == 0.5
+        assert c4.compress == "none"
+
+    def test_ladder_records_log_skips_with_applied_false(self):
+        cfg = small_cfg()
+        recs = ladder_records(cfg, 2, run_id="r" * 8, ridx=3, engine="cpc")
+        for r in recs:
+            validate_record(r)
+            assert r["intervention"] == "ladder_override"
+        skipped = [r for r in recs if r.get("applied") is False]
+        assert [r["param"] for r in skipped] == ["compress"]
+        assert "skipped" in skipped[0]["reason"]
+        applied = [r for r in recs if r["applied"]]
+        assert {r["param"] for r in applied} == {"update_guard",
+                                                "quarantine_rounds"}
+
+    def test_cpc_engine_builds_every_degraded_config(self):
+        # the whole point of the exclusion table: walk the ladder to its
+        # deepest rung and hand each degraded config to the actual CPC
+        # constructor — none may raise
+        from federated_pytorch_test_tpu.data.lofar import CPCDataSource
+        from federated_pytorch_test_tpu.train.cpc_engine import CPCTrainer
+
+        src = CPCDataSource(["a.h5", "b.h5"], ["0", "1"], batch_size=2,
+                            seed=7)
+        cfg = FederatedConfig(check_results=False)
+        for attempt in (1, 2, 3, 4):
+            _, degraded, _ = ladder_overrides(cfg, attempt, engine="cpc")
+            CPCTrainer(src, latent_dim=8, reduced_dim=4, lbfgs_history=3,
+                       lbfgs_max_iter=1, Niter=1,
+                       cfg=degraded)           # must not raise
+        # counterfactual: the unfiltered classifier ladder at the same
+        # rung is NOT constructible — the exclusion table is load-bearing
+        _, bad, _ = ladder_overrides(cfg, 2)
+        with pytest.raises(ValueError, match="compress"):
+            CPCTrainer(src, latent_dim=8, reduced_dim=4, lbfgs_history=3,
+                       lbfgs_max_iter=1, Niter=1, cfg=bad)
+
+
+class TestCPCSupervised:
+    def test_crash_resume_matches_uninterrupted(self, tmp_path):
+        """Supervised CPC (bare ``supervise`` + ladder_records describe,
+        the drivers/federated_cpc path): one injected crash, restart 1
+        resumes plain from the midrun slot and the stitched history is
+        exactly the uninterrupted run's (``*_seconds`` stripped)."""
+        from federated_pytorch_test_tpu.data.lofar import CPCDataSource
+        from federated_pytorch_test_tpu.train.cpc_engine import CPCTrainer
+
+        def make():
+            src = CPCDataSource(["a.h5", "b.h5"], ["0", "1"],
+                                batch_size=2, seed=7)
+            return CPCTrainer(src, latent_dim=8, reduced_dim=4,
+                              lbfgs_history=3, lbfgs_max_iter=1, Niter=1,
+                              cfg=FederatedConfig(check_results=False))
+
+        # same normalization as tests/test_resume.py: the restarted
+        # process re-compiles, so cache_hit / peak_device_bytes land on
+        # rounds the uninterrupted run attributed differently
+        strip = lambda h: [
+            {k: v for k, v in r.items()
+             if not k.endswith("_seconds")
+             and k not in ("cache_hit", "peak_device_bytes")} for r in h]
+        _, want = make().run(Nloop=1, Nadmm=2, log=lambda m: None)
+
+        ck = str(tmp_path / "cpc_sup_ck")
+
+        class Crash(Exception):
+            pass
+
+        calls = []
+
+        def maybe_bomb(msg):
+            calls.append(msg)
+            if len(calls) == 3:
+                raise Crash
+
+        def run_attempt(attempt, resume_now):
+            t = make()
+            log = maybe_bomb if attempt == 1 else (lambda m: None)
+            return t.run(Nloop=1, Nadmm=2, log=log, checkpoint_path=ck,
+                         resume=resume_now)
+
+        _, got = supervise(run_attempt, max_restarts=2, backoff_base=0.0,
+                           seed=5, retry_on=(Crash,), log=lambda m: None)
+        assert strip(got) == strip(want)

@@ -7,6 +7,8 @@ K in {4, 8} on the virtual CPU mesh, one loop, and 1-4 comm rounds — the
 whole module is part of the `-m 'not slow'` smoke path.
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -837,3 +839,210 @@ class TestValidation:
             BlockwiseFederatedTrainer(
                 TinyNet(), small_cfg(update_guard=True,
                                      guard_norm_mult=0.0), data, FedAvg())
+
+
+# ---------------------------------------------------------------------------
+# engine parity: the one round kernel on VAE and CPC (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+def run_vae(data, L=1, **cfg_kw):
+    from federated_pytorch_test_tpu.models.vae import AutoEncoderCNN
+    from federated_pytorch_test_tpu.train.vae_engine import VAETrainer
+
+    base = dict(K=8, Nloop=1, Nepoch=1, Nadmm=3, default_batch=16,
+                check_results=False, admm_rho0=0.1)
+    base.update(cfg_kw)
+    t = VAETrainer(AutoEncoderCNN(), FederatedConfig(**base), data, FedAvg())
+    t.L = L
+    return t, t.run(log=lambda m: None)
+
+
+class TestVAEKernelParity:
+    """The classifier's guard/quarantine and Byzantine-survival
+    contracts verbatim on the VAE engine — same kernel, same knobs,
+    same cadence and tolerance band."""
+
+    def test_quarantine_cadence(self, data8):
+        _, (_, hist) = run_vae(data8,
+                               fault_spec="corrupt=1,mode=nan,clients=0",
+                               update_guard=True, quarantine_rounds=1)
+        assert [h["guard_trips"] for h in hist] == [1.0, 0.0, 1.0]
+        assert [h["quarantined"] for h in hist] == [0, 1, 0]
+        assert [h["n_active"] for h in hist] == [8, 7, 8]
+        assert all(np.isfinite(h["loss"]) for h in hist)
+
+    DELAY = "delay=0.3,delay_max=2,seed=11"
+    ATTACK = "corrupt=1,clients=0,mode=nan," + DELAY
+
+    @pytest.fixture(scope="class")
+    def clean_vae_loss(self, data8):
+        _, (_, hist) = run_vae(data8, fault_spec=self.DELAY,
+                               async_rounds=True, max_staleness=4)
+        return hist[-1]["loss"]
+
+    @pytest.mark.parametrize("agg,frac", [("median", 0.2), ("krum", 0.4)])
+    def test_byzantine_nan_tracks_clean_baseline(self, data8,
+                                                 clean_vae_loss, agg, frac):
+        # the ISSUE 15 acceptance shape: 1-of-8 Byzantine NaN client
+        # under delay stragglers (buffered-async admission), no guard —
+        # the robust aggregator alone must keep the run finite and
+        # within 5% of the clean async baseline
+        _, (_, hist) = run_vae(data8, fault_spec=self.ATTACK,
+                               async_rounds=True, max_staleness=4,
+                               robust_agg=agg, trim_frac=frac)
+        loss = hist[-1]["loss"]
+        assert np.isfinite(loss)
+        assert abs(loss - clean_vae_loss) / clean_vae_loss < 0.05
+
+
+def run_cpc(src, Nadmm=1, run_kw=None, **cfg_kw):
+    from federated_pytorch_test_tpu.train.cpc_engine import CPCTrainer
+
+    t = CPCTrainer(src, latent_dim=8, reduced_dim=4, lbfgs_history=3,
+                   lbfgs_max_iter=1, Niter=1,
+                   cfg=FederatedConfig(check_results=False, **cfg_kw))
+    kw = dict(log=lambda m: None)
+    kw.update(run_kw or {})
+    return t, t.run(Nloop=1, Nadmm=Nadmm, **kw)
+
+
+@pytest.fixture(scope="module")
+def cpc_chaos(tmp_path_factory):
+    """Seeded corrupt=nan CPC run: client 1 ships NaN every round it is
+    admitted; guard + quarantine on, JSONL + memory sinks recording."""
+    from federated_pytorch_test_tpu.data.lofar import CPCDataSource
+
+    d = tmp_path_factory.mktemp("cpc_chaos")
+    src = CPCDataSource(["a.h5", "b.h5"], ["0", "1"], batch_size=2, seed=7)
+    t, (state, hist) = run_cpc(
+        src, Nadmm=3,
+        fault_spec="corrupt=1,mode=nan,clients=1,seed=7",
+        update_guard=True, quarantine_rounds=1,
+        run_kw=dict(obs_dir=str(d), obs_sinks="jsonl,memory"))
+    jsonls = [os.path.join(d, f) for f in os.listdir(d)
+              if f.endswith(".jsonl")]
+    assert len(jsonls) == 1
+    return t, state, hist, jsonls[0]
+
+
+class TestCPCKernelParity:
+    """Guard cadence, client-grain attribution, and async kill/resume
+    ledger exactness on the CPC rotation — the knobs that were
+    classifier-only before the round kernel."""
+
+    def test_quarantine_cadence(self, cpc_chaos):
+        # encoder block 0 runs Nadmm=3 rounds first: client 1 trips in
+        # round 0, sits out round 1 (quarantined), returns and trips in
+        # round 2 — the classifier cadence verbatim
+        _, _, hist, _ = cpc_chaos
+        assert [h["guard_trips"] for h in hist[:3]] == [1.0, 0.0, 1.0]
+        assert [h["quarantined"] for h in hist[:3]] == [0, 1, 0]
+        assert [h["n_active"] for h in hist[:3]] == [2, 1, 2]
+        assert all(np.isfinite(h["loss"]) for h in hist)
+
+    def test_client_records_name_the_corrupt_client(self, cpc_chaos):
+        from federated_pytorch_test_tpu.obs.clients import (
+            ledger_from_records,
+        )
+        from federated_pytorch_test_tpu.obs.report import read_records
+
+        t, _, hist, path = cpc_chaos
+        crecs = [r for r in t.obs_recorder.memory if r["event"] == "client"]
+        assert len(crecs) == len(hist) > 0
+        led = ledger_from_records(read_records(path))
+        assert led.ranking()[0]["client"] == 1
+
+    def test_cli_expect_top_gate_on_cpc_stream(self, cpc_chaos, capsys):
+        from federated_pytorch_test_tpu.obs.clients import (
+            main as clients_main,
+        )
+
+        _, _, _, path = cpc_chaos
+        assert clients_main([path, "--expect-top", "1"]) == 0
+        assert clients_main([path, "--expect-top", "0"]) == 2
+        capsys.readouterr()
+
+    def test_async_kill_resume_ledger_exact(self, tmp_path):
+        # --async-rounds with delay stragglers, guard + quarantine and a
+        # median aggregator: interrupting mid-block and resuming must
+        # reproduce the uninterrupted history EXACTLY — staleness
+        # weights, fault counters, quarantine ticks and client-ledger
+        # fields included (only wall-clock *_seconds and per-process
+        # compile-cache attribution stripped, as in tests/test_resume.py)
+        from federated_pytorch_test_tpu.data.lofar import CPCDataSource
+
+        def make_src():
+            return CPCDataSource(["a.h5", "b.h5"], ["0", "1"],
+                                 batch_size=2, seed=7)
+
+        kw = dict(fault_spec="corrupt=0.5,clients=0,mode=scale,scale=9,"
+                             "delay=0.4,delay_max=2,seed=13",
+                  async_rounds=True, max_staleness=3,
+                  update_guard=True, quarantine_rounds=1,
+                  robust_agg="median")
+        strip = lambda h: [
+            {k: v for k, v in r.items()
+             if not k.endswith("_seconds")
+             and k not in ("cache_hit", "peak_device_bytes")} for r in h]
+        _, (_, want) = run_cpc(make_src(), Nadmm=2, **kw)
+        ck = str(tmp_path / "cpc_async_ck")
+
+        class Stop(Exception):
+            pass
+
+        calls = []
+
+        def bomb(msg):
+            calls.append(msg)
+            if len(calls) == 3:
+                raise Stop
+
+        with pytest.raises(Stop):
+            run_cpc(make_src(), Nadmm=2,
+                    run_kw=dict(log=bomb, checkpoint_path=ck), **kw)
+        _, (_, got) = run_cpc(make_src(), Nadmm=2,
+                              run_kw=dict(checkpoint_path=ck, resume=True),
+                              **kw)
+        assert strip(got) == strip(want)
+
+
+@pytest.mark.slow
+class TestCPCAdversarialConvergence:
+    """ISSUE 15 acceptance: 1-of-8 Byzantine NaN client under delay
+    stragglers (buffered-async admission) survives via krum/median
+    within 5% of the clean async baseline on the CPC engine, while the
+    plain mean goes non-finite."""
+
+    DELAY = "delay=0.3,delay_max=2,seed=11"
+    ATTACK = "corrupt=1,clients=0,mode=nan," + DELAY
+
+    @pytest.fixture(scope="class")
+    def cpc_src8(self):
+        from federated_pytorch_test_tpu.data.lofar import CPCDataSource
+
+        return CPCDataSource([f"{c}.h5" for c in "abcdefgh"],
+                             [str(i % 2) for i in range(8)],
+                             batch_size=2, seed=7)
+
+    def _final_loss(self, src, **kw):
+        _, (_, hist) = run_cpc(src, Nadmm=2, async_rounds=True,
+                               max_staleness=4, **kw)
+        return hist[-1]["loss"]
+
+    @pytest.fixture(scope="class")
+    def clean_async_loss(self, cpc_src8):
+        return self._final_loss(cpc_src8, fault_spec=self.DELAY)
+
+    @pytest.mark.parametrize("agg,frac", [("median", 0.2), ("krum", 0.4)])
+    def test_byzantine_nan_tracks_clean_baseline(self, cpc_src8,
+                                                 clean_async_loss,
+                                                 agg, frac):
+        loss = self._final_loss(cpc_src8, fault_spec=self.ATTACK,
+                                robust_agg=agg, trim_frac=frac)
+        assert np.isfinite(loss)
+        assert abs(loss - clean_async_loss) / clean_async_loss < 0.05
+
+    def test_plain_mean_goes_nonfinite(self, cpc_src8):
+        loss = self._final_loss(cpc_src8, fault_spec=self.ATTACK)
+        assert not np.isfinite(loss)

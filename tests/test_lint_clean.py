@@ -77,6 +77,19 @@ class TestGraftcheckClean:
         assert resolved >= 4, "shard_map body resolver regressed"
         assert list(ShardingAnnotation().check(module)) == []
 
+    def test_round_kernel_in_gate_and_clean(self):
+        """The unified round kernel (train/rounds.py) is the one file
+        every engine's robustness path now flows through — make the gate
+        non-vacuous for it specifically: the file must exist inside the
+        gated tree and must lint clean on its own (a rename out of the
+        package would otherwise silently drop it from the package-wide
+        assertions above)."""
+        path = (REPO / "federated_pytorch_test_tpu" / "train" / "rounds.py")
+        assert path.exists(), "round kernel moved out of the gated tree"
+        result = LintEngine(ALL_RULES).lint_paths([str(path)])
+        failing = result.failing(Severity.WARNING)
+        assert failing == [], "\n".join(f.render() for f in failing)
+
     def test_changed_gate_exits_zero(self, tmp_path, capsys):
         """The pre-commit path: ``--changed HEAD`` with a summary cache
         over the shipped tree must agree with the full run (exit 0).
