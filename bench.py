@@ -1285,9 +1285,155 @@ def _smoke() -> int:
                              "--threshold", "2"])
 
 
+_POPULATION_BASELINE = "artifacts/POPULATION_BASELINE.json"
+_POPULATION_METRIC = "population_sublinearity_savings_ratio"
+
+
+def _population_round_seconds(population: int) -> float:
+    """Steady-state per-round wall clock (median over the run's round
+    records, which rides out the per-block compile rounds) for a tiny
+    real engine with ``population`` registered clients sampled down to
+    the fixed 8-slot cohort on the forced 8-device CPU mesh."""
+    import numpy as np
+
+    import flax.linen as nn
+
+    from federated_pytorch_test_tpu.data.cifar10 import FederatedCifar10
+    from federated_pytorch_test_tpu.models.base import (
+        BlockModule,
+        elu,
+        flatten,
+        max_pool_2x2,
+        pairs,
+    )
+    from federated_pytorch_test_tpu.train import (
+        AdmmConsensus,
+        BlockwiseFederatedTrainer,
+        FederatedConfig,
+    )
+
+    class PopNet(BlockModule):
+        @nn.compact
+        def __call__(self, x, train=True):
+            x = max_pool_2x2(elu(nn.Conv(4, (5, 5), strides=(2, 2),
+                                         name="conv1")(x)))
+            return nn.Dense(10, name="fc1")(flatten(x))
+
+        def param_order(self):
+            return pairs("conv1", "fc1")
+
+        def train_order_block_ids(self):
+            return [[0, 1], [2, 3]]
+
+        def linear_layer_ids(self):
+            return [1]
+
+    K = 8
+    cfg = FederatedConfig(K=K, Nloop=1, Nepoch=1, Nadmm=6, default_batch=16,
+                          check_results=False, admm_rho0=0.1, seed=0,
+                          population=population)
+    data = FederatedCifar10(K=K, batch=16, limit_per_client=16,
+                            limit_test=16)
+    trainer = BlockwiseFederatedTrainer(PopNet(), cfg, data,
+                                        AdmmConsensus())
+    _, hist = trainer.run(log=lambda m: None)
+    secs = [float(r["round_seconds"]) for r in hist
+            if "round_seconds" in r and "nadmm" in r]
+    if not secs:
+        raise RuntimeError("population bench run produced no round records")
+    return float(np.median(secs))
+
+
+def _population_bench() -> int:
+    """``bench.py --population-bench``: the no-TPU CI gate for population
+    federation (population/).  Registers K virtual clients for K in
+    {256, 2048, 10240} over a FIXED 8-slot cohort on the forced 8-device
+    CPU mesh, times steady-state rounds, and emits a bench-shaped
+    artifact (``artifacts/population.json``) whose headline is the
+    sublinearity ratio
+
+        (K_hi / K_lo) / (wall_hi / wall_lo)
+
+    — the factor of the 40x registry growth that per-round wall clock
+    did NOT pay.  40 means rounds cost the same at 10,240 registered
+    clients as at 256 (perfectly cohort-bounded); 1 would mean rounds
+    scale linearly in K.  Every number here is a CPU-box timing, so the
+    committed-baseline gate runs with a WIDE threshold: it exists to
+    catch the subsystem going accidentally linear-in-K, not 10%% drift.
+    """
+    # must land before this process's first jax import
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    populations = [256, 2048, 10240]
+    out = {
+        "metric": _POPULATION_METRIC,
+        "unit": "x (K-growth over wall-growth, steady-state rounds)",
+        "measured": True,
+        "baseline_ref": _POPULATION_BASELINE,
+        "population_cohort": 8,
+        "population_registered_max": populations[-1],
+    }
+    walls = {}
+    try:
+        for pop in populations:
+            walls[pop] = _population_round_seconds(pop)
+            out[f"population_K{pop}_round_seconds"] = round(walls[pop], 4)
+    except Exception as e:      # noqa: BLE001 — report, don't traceback
+        out["error"] = (
+            f"population bench run failed: {type(e).__name__}: {e}")
+    if not out.get("error"):
+        lo, hi = populations[0], populations[-1]
+        out["value"] = round((hi / lo) / (walls[hi] / walls[lo]), 4)
+        out["population_round_throughput"] = round(1.0 / walls[hi], 4)
+        # human-readable section mirroring the gated flat fields
+        out["population"] = {
+            "registered": populations,
+            "cohort": 8,
+            "rounds_per_second_at_max_K": out["population_round_throughput"],
+            "round_seconds": {str(p): out[f"population_K{p}_round_seconds"]
+                              for p in populations},
+            "sublinearity_ratio": out["value"],
+        }
+    out["captured_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    out["git"] = _git_describe()
+    art_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "artifacts")
+    path = os.path.join(art_dir, "population.json")
+    try:
+        os.makedirs(art_dir, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+    except OSError as e:
+        print(f"bench: cannot write population artifact: {e}",
+              file=sys.stderr)
+        return 1
+    print(json.dumps(out))
+    if out.get("error"):
+        return 1
+    baseline = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            _POPULATION_BASELINE)
+    if not os.path.exists(baseline):
+        print(f"bench: no committed {_POPULATION_BASELINE}; population gate "
+              "skipped (commit the emitted artifacts/population.json there "
+              "to arm it)", file=sys.stderr)
+        return 0
+    from federated_pytorch_test_tpu.obs import compare as obs_compare
+
+    # timings on shared CI boxes: gate only on halving/doubling-scale
+    # movement of the ratio and throughput, anything subtler is info
+    return obs_compare.main([path, "--baseline", baseline,
+                             "--threshold", "45"])
+
+
 if __name__ == "__main__":
     if "--measure" in sys.argv[1:]:
         sys.exit(_measure_child())
     if "--smoke" in sys.argv[1:]:
         sys.exit(_smoke())
+    if "--population-bench" in sys.argv[1:]:
+        sys.exit(_population_bench())
     main()

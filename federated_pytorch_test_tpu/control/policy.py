@@ -19,6 +19,13 @@ typed :class:`Decision` objects:
   guard-spike pressure when the robust aggregator uses it (restart
   scope: the mean fn is baked at construction; the restart supervisor
   applies it on the next segment).
+- ``shrink_cohort`` / ``grow_cohort`` — under population federation
+  (``--population K``) throughput collapse first halves ``cohort_frac``
+  (the fraction of sampled cohort slots active per round) down to a
+  floor of 0.25, and sustained healthy throughput doubles it back
+  toward the configured value (round scope: the round kernel reads the
+  knob on the host every round).  Tried BEFORE ``shrink_batch`` — a
+  smaller cohort is cheaper to undo than a pipeline rebuild.
 - ``shrink_batch`` / ``grow_batch`` — halve/double ``default_batch``
   within declared bounds on throughput collapse/recovery vs the rolling
   median (restart scope: the data pipeline is built at construction).
@@ -160,12 +167,14 @@ class ControlPolicy:
     TRIM_MAX = 0.45
     STALENESS_RELAX_LIMIT = 4  # max rounds above the configured cutoff
     TPUT_OK_FRAC = 0.75       # healthy-throughput floor vs rolling median
+    COHORT_FRAC_MIN = 0.25    # floor the cohort rung shrinks toward
 
     def __init__(self, *, preset: str = "default", compress: str = "none",
                  max_staleness: int = 4, trim_frac: float = 0.1,
                  default_batch: int = 128, robust_agg: str = "none",
                  fused_collective: bool = False, async_rounds: bool = False,
-                 window: int = 8):
+                 window: int = 8, population: int = 0,
+                 cohort_frac: float = 1.0):
         if preset not in _PRESETS:
             raise ValueError(f"control policy {preset!r} not in "
                              f"{CONTROL_POLICIES}")
@@ -191,12 +200,15 @@ class ControlPolicy:
         self._batch_min = max(8, self._start_batch // 4)
         self._trim_capable = robust_agg in ("trim", "krum")
         self._async = bool(async_rounds)
+        self._pop = int(population) > 0
+        self._start_frac = float(cohort_frac)
         # internal knob view: advances when a decision fires (BOTH
         # modes — see module docstring determinism note)
         self.cur_compress = self._start_compress
         self.cur_staleness = self._start_staleness
         self.cur_trim = self._start_trim
         self.cur_batch = self._start_batch
+        self.cur_frac = self._start_frac
         # hysteresis state: per-rule consecutive-round counters and a
         # per-param cooldown horizon (round index the param re-arms at)
         self._streaks: Dict[str, int] = {}
@@ -216,6 +228,8 @@ class ControlPolicy:
             fused_collective=bool(_cfg_get(cfg, "fused_collective", False)),
             async_rounds=bool(_cfg_get(cfg, "async_rounds", False)),
             window=int(_cfg_get(cfg, "health_window", 8)),
+            population=int(_cfg_get(cfg, "population", 0)),
+            cohort_frac=float(_cfg_get(cfg, "cohort_frac", 1.0)),
         )
 
     # -- hysteresis plumbing -------------------------------------------
@@ -302,17 +316,33 @@ class ControlPolicy:
             if d:
                 self.cur_trim = new
                 out.append(d)
-        elif (rule == "throughput_collapse"
-              and self.cur_batch > self._batch_min):
-            new = max(self._batch_min, self.cur_batch // 2)
-            d = self._decide(
-                ridx, "shrink_batch", "default_batch", self.cur_batch,
-                new, SCOPE_RESTART,
-                "throughput collapse vs rolling median: shrink the "
-                "minibatch", observed=obs, threshold=thr, streak=stk)
-            if d:
-                self.cur_batch = new
-                out.append(d)
+        elif rule == "throughput_collapse":
+            if (self._pop
+                    and self.cur_frac > self.COHORT_FRAC_MIN + 1e-9):
+                # population mode: the cohort rung goes first — a
+                # host-read knob the kernel applies next round, far
+                # cheaper to undo than a restart-scope pipeline rebuild
+                new = round(max(self.COHORT_FRAC_MIN,
+                                self.cur_frac / 2), 4)
+                d = self._decide(
+                    ridx, "shrink_cohort", "cohort_frac", self.cur_frac,
+                    new, SCOPE_ROUND,
+                    "throughput collapse vs rolling median: shrink the "
+                    "sampled cohort before touching the minibatch",
+                    observed=obs, threshold=thr, streak=stk)
+                if d:
+                    self.cur_frac = new
+                    out.append(d)
+            elif self.cur_batch > self._batch_min:
+                new = max(self._batch_min, self.cur_batch // 2)
+                d = self._decide(
+                    ridx, "shrink_batch", "default_batch", self.cur_batch,
+                    new, SCOPE_RESTART,
+                    "throughput collapse vs rolling median: shrink the "
+                    "minibatch", observed=obs, threshold=thr, streak=stk)
+                if d:
+                    self.cur_batch = new
+                    out.append(d)
         return out
 
     def _observe_client(self, rec: Dict[str, Any]) -> List[Decision]:
@@ -430,6 +460,26 @@ class ControlPolicy:
                         streak=n)
                     if d:
                         self.cur_batch = new
+                        out.append(d)
+            # cohort walk-back: sustained healthy throughput after a
+            # shrink_cohort regrows the sampled fraction (round scope)
+            if (self._pop and self.cur_frac < self._start_frac - 1e-9
+                    and len(self._ips) >= self.window):
+                med = sorted(self._ips)[len(self._ips) // 2]
+                n = self._bump("cohort_ok",
+                               ips >= self.TPUT_OK_FRAC * med)
+                if n >= 2 * self.streak:
+                    new = round(min(self._start_frac,
+                                    self.cur_frac * 2), 4)
+                    d = self._decide(
+                        ridx, "grow_cohort", "cohort_frac",
+                        self.cur_frac, new, SCOPE_ROUND,
+                        f"throughput healthy vs rolling median for {n} "
+                        "rounds: regrow the sampled cohort",
+                        observed=ips, threshold=self.TPUT_OK_FRAC * med,
+                        streak=n)
+                    if d:
+                        self.cur_frac = new
                         out.append(d)
             self._ips.append(ips)
 

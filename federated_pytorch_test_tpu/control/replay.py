@@ -7,7 +7,11 @@ round and alert records through it IN FILE ORDER, and diffs the derived
 decision sequence against the recorded ``control`` records.  Supervisor
 records are checked too: the seeded backoff of every ``restart`` record
 is recomputed from (``restart_backoff``, ``seed``, ``attempt``) and the
-attempt numbers must count up from 1.
+attempt numbers must count up from 1.  Under population federation the
+recorded cohorts are part of the contract: every ``client`` record's
+``registry_ids`` must re-derive from the seeded sampler given only the
+header config and the round's loop coordinates
+(:func:`check_cohort_records`).
 
 Exit 0 when every recorded decision is reproduced bit-exactly; exit 1
 (with a diff) on any divergence — the determinism contract of the
@@ -223,6 +227,72 @@ def check_reshape_records(segments: List[List[Dict[str, Any]]],
     return checked
 
 
+def check_cohort_records(segments: List[List[Dict[str, Any]]],
+                         errors: List[str]) -> int:
+    """Verify recorded population cohorts against the seeded sampler.
+
+    Population mode: every ``client`` record's ``registry_ids`` must
+    equal ``population.sampler.sample_cohort`` recomputed from the
+    header config (``seed``/``population``/``K``/``cohort_sampling``)
+    and the matching round record's loop coordinates.  The cohort draw
+    is stateless and frac-free (the control plane's cohort rung masks
+    slots, it never perturbs WHICH ids were drawn), so the whole
+    sequence re-derives from the header alone — across kill/resume and
+    mesh-reshape segment boundaries exactly like policy decisions.
+    """
+    from federated_pytorch_test_tpu.population.sampler import sample_cohort
+
+    checked = 0
+    for si, segment in enumerate(segments):
+        header = next((r for r in segment
+                       if r.get("event") == "run_header"), None)
+        config = (header or {}).get("config")
+        crecs = [r for r in segment if r.get("event") == "client"
+                 and isinstance(r.get("registry_ids"), list)]
+        if not crecs:
+            continue
+        pop = (config or {}).get("population") if isinstance(config, dict) \
+            else None
+        if not isinstance(pop, int) or pop <= 0:
+            errors.append(
+                f"segment {si}: client record(s) carry registry_ids but "
+                "the header config has population off (or no config "
+                "snapshot) — cannot have been produced by this "
+                "configuration")
+            continue
+        K = int(config.get("K", 0))
+        seed = int(config.get("seed", 0))
+        method = str(config.get("cohort_sampling", "uniform"))
+        coords: Dict[int, Tuple] = {}
+        for r in segment:
+            if (r.get("event") == "round"
+                    and isinstance(r.get("round_index"), int)):
+                coords.setdefault(
+                    r["round_index"],
+                    (r.get("nloop"), r.get("block"), r.get("nadmm")))
+        for rec in crecs:
+            ridx = rec.get("round_index")
+            c = coords.get(ridx)
+            if c is None or not all(isinstance(v, int) for v in c):
+                errors.append(
+                    f"segment {si} round {ridx}: client record carries "
+                    "registry_ids but no round record supplies the loop "
+                    "coordinates to recompute the draw")
+                continue
+            checked += 1
+            want = sample_cohort(pop, K, seed=seed, nloop=c[0], ci=c[1],
+                                 nadmm=c[2], method=method).tolist()
+            got = [int(v) for v in rec["registry_ids"]]
+            if got != want:
+                errors.append(
+                    f"segment {si} round {ridx}: recorded cohort "
+                    f"{got[:8]}{'...' if len(got) > 8 else ''} diverges "
+                    f"from the seeded draw "
+                    f"{want[:8]}{'...' if len(want) > 8 else ''} "
+                    f"(seed={seed}, population={pop}, method={method})")
+    return checked
+
+
 def replay(records: List[Dict[str, Any]]) -> Tuple[List[str], Dict[str, int]]:
     """Full replay check; returns (errors, stats)."""
     errors: List[str] = []
@@ -230,9 +300,11 @@ def replay(records: List[Dict[str, Any]]) -> Tuple[List[str], Dict[str, int]]:
     n_policy = check_policy_records(segments, errors)
     n_sup = check_supervisor_records(records, errors)
     n_reshape = check_reshape_records(segments, errors)
+    n_cohort = check_cohort_records(segments, errors)
     return errors, {"segments": len(segments), "policy_records": n_policy,
                     "supervisor_records": n_sup,
-                    "reshape_records": n_reshape}
+                    "reshape_records": n_reshape,
+                    "cohort_records": n_cohort}
 
 
 def selftest() -> str:
@@ -347,6 +419,38 @@ def selftest() -> str:
         errors9, _ = replay(
             [r for r in elastic if r.get("intervention") != "reshape"])
         assert errors9 and "dropped" in errors9[0], errors9
+
+        # population cohorts: registry_ids re-derive from the seeded
+        # sampler; a tampered id list is a divergence
+        from federated_pytorch_test_tpu.population.sampler import (
+            sample_cohort)
+        d5 = os.path.join(d, "pop")
+        os.makedirs(d5, exist_ok=True)
+        base = read_records(synth(d5, [0.1, 0.1], name="pop"))
+        popped = [dict(r, config=dict(config, population=16))
+                  if r.get("event") == "run_header" else r for r in base]
+        clients = []
+        for r in base:
+            if r.get("event") == "round":
+                ids = sample_cohort(16, 2, seed=0, nloop=r["nloop"],
+                                    ci=r["block"], nadmm=r["nadmm"],
+                                    method="uniform")
+                clients.append({"event": "client",
+                                "schema": SCHEMA_VERSION, "run_id": "x",
+                                "round_index": r["round_index"],
+                                "clients": 2,
+                                "registry_ids": ids.tolist()})
+        errors10, stats10 = replay(popped + clients)
+        assert not errors10, errors10
+        assert stats10["cohort_records"] == 2, stats10
+        bad = [dict(c) for c in clients]
+        bad[0]["registry_ids"] = [(v + 1) % 16
+                                  for v in bad[0]["registry_ids"]]
+        errors11, _ = replay(popped + bad)
+        assert errors11 and "seeded draw" in errors11[0], errors11
+        # registry_ids on a population-off stream is itself a divergence
+        errors12, _ = replay(base + clients)
+        assert errors12 and "population off" in errors12[0], errors12
         json.dumps(stats)  # stats stay JSON-representable
     return "control replay selftest: OK (decisions reproduce; tampering detected)"
 
@@ -381,8 +485,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"  - {e}")
         return 1
     print(f"replay OK: {stats['policy_records']} policy decision(s), "
-          f"{stats['supervisor_records']} supervisor record(s) and "
-          f"{stats['reshape_records']} reshape record(s) reproduce "
+          f"{stats['supervisor_records']} supervisor record(s), "
+          f"{stats['reshape_records']} reshape record(s) and "
+          f"{stats['cohort_records']} cohort record(s) reproduce "
           f"across {stats['segments']} segment(s)")
     return 0
 

@@ -139,6 +139,16 @@ def _stage_robust_agg(cfg, engine: str = "classifier") -> Dict[str, Any]:
 
 
 def _stage_reduced_cohort(cfg, engine: str = "classifier") -> Dict[str, Any]:
+    # population mode: the cohort is the scheduling unit, so degrade the
+    # sampled-cohort fraction (the knob the round kernel reads per
+    # round) instead of the per-slot participation coin
+    if int(getattr(cfg, "population", 0) or 0) > 0:
+        f = float(getattr(cfg, "cohort_frac", 1.0) or 1.0)
+        if f > 0.5:
+            return {"cohort_frac": 0.5}
+        if f > 0.25:
+            return {"cohort_frac": round(f / 2.0, 4)}
+        return {}
     # partial participation is forbidden under bb_update
     if (getattr(cfg, "bb_update", False)
             or "participation" in ENGINE_LADDER_EXCLUSIONS.get(engine, ())):

@@ -88,6 +88,17 @@ def build_parser(defaults: FederatedConfig, prog: str) -> argparse.ArgumentParse
                 arg, choices=CONTROL_POLICIES, default=default,
                 help="hysteresis preset for --control decisions "
                      "(control/policy.py; default: default)")
+        elif f.name == "cohort_sampling":
+            from federated_pytorch_test_tpu.population import (
+                SAMPLER_CHOICES,
+            )
+            p.add_argument(
+                arg, choices=SAMPLER_CHOICES, default=default,
+                help="population cohort sampler (population/sampler.py): "
+                     "uniform, weighted (seeded static availability "
+                     "weights) or stratified (one id per contiguous "
+                     "stratum); only meaningful with --population > 0 "
+                     "(default: uniform)")
         elif f.name == "compile_cache_dir":
             p.add_argument(
                 arg, type=str, default=default, metavar="DIR",

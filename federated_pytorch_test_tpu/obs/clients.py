@@ -1,11 +1,17 @@
-"""Client-grain flight recorder (schema v10): ledger, ranking, cohorts.
+"""Client-grain flight recorder (schema v11): ledger, ranking, cohorts.
 
 The engines emit one ``client`` record per communication round — the
 round record's counters, un-aggregated: parallel length-K lists of
 per-client update norms, delta-vs-z distance, loss contribution, guard
 verdicts, quarantine state, fault tags, async staleness/admission, and
-churn membership (``obs/schema.py`` v10).  This module is the reader
-side:
+churn membership (``obs/schema.py`` v10).  Under population federation
+(``--population K``, schema v11) each record additionally carries
+``registry_ids`` — the registry ids of the sampled cohort occupying the
+K device slots that round — and the ledger rekeys every aggregate by
+registry id: records stay cohort-sized while the ledger grows to the
+set of clients ever sampled, byte-exactly reproducible from the stream
+even though K vastly exceeds any single record's length.  This module
+is the reader side:
 
 - :class:`ClientLedger` — streaming accumulator over ``client`` records
   (pure function of the stream, float64 host math: replaying the same
@@ -62,16 +68,18 @@ def client_round_fields(round_index: int, clients: int, *,
                         weight=None, active=None, guard_ok=None,
                         quarantine=None, dropped=None, straggled=None,
                         corrupted=None, staleness=None, admitted=None,
-                        members=None,
+                        members=None, registry_ids=None,
                         payload_bytes: Optional[int] = None
                         ) -> Dict[str, Any]:
-    """Assemble a schema-v10 ``client`` record body from host arrays.
+    """Assemble a schema-v11 ``client`` record body from host arrays.
 
     Every array argument is optional (advisory fields — absent means
     "that subsystem was off") and is coerced to a plain length-K Python
     list so the record validates and JSON-round-trips (NaN entries
     survive: the JSONL sink writes ``NaN``, ``json.loads`` reads it
     back).  ``staleness`` uses -1 for "no arrival this round".
+    ``registry_ids`` (population mode) maps slot k to the registry id
+    of the virtual client that occupied it this round.
     """
     fields: Dict[str, Any] = {"round_index": int(round_index),
                               "clients": int(clients)}
@@ -98,9 +106,19 @@ def client_round_fields(round_index: int, clients: int, *,
     put("staleness", staleness, int)
     put("admitted", admitted, float)
     put("members", members, float)
+    put("registry_ids", registry_ids, int)
     if payload_bytes is not None:
         fields["payload_bytes"] = int(payload_bytes)
     return fields
+
+
+#: per-client float64 aggregate arrays (one row per ledger client)
+_STATS = ("norm_sum", "norm_n", "nonfinite", "dist_sum",
+          "dist_n", "loss_sum", "weight_sum", "active_rounds",
+          "guard_checks", "guard_fails", "quar_rounds",
+          "drops", "straggles", "corrupts", "arrivals",
+          "admits", "rejects", "stale_sum", "bytes",
+          "member_rounds", "joins", "leaves")
 
 
 class ClientLedger:
@@ -111,37 +129,53 @@ class ClientLedger:
     aggregates are float64 numpy — a pure function of the stream, so
     recomputing from the recorded JSONL reproduces them bit-exactly
     (the replay contract the anomaly ranking inherits).
+
+    Ledger rows are keyed by REGISTRY id: a record with
+    ``registry_ids`` (population mode, schema v11) contributes its
+    cohort-sized lists to the rows of the sampled clients only; rows
+    are allocated on first sighting, so the ledger grows to the set of
+    clients ever sampled while every record stays cohort-bounded.
+    Records without ``registry_ids`` key slot k to client id k — the
+    mapping is the identity for dense streams, so every pre-population
+    aggregate is byte-identical.
     """
 
     def __init__(self):
-        self.clients = 0              # cohort size K (grown on first record)
+        self.clients = 0              # distinct clients observed (rows)
         self.records = 0              # client records observed
+        self.sparse = False           # saw a registry_ids record
         self._rounds: List[int] = []  # round_index per record, file order
-        self._glyphs: List[List[str]] = []   # per record: [K] glyphs
-        self._prev_members: Optional[np.ndarray] = None
+        #: per record: (ledger-row index array, [k] glyphs)
+        self._glyphs: List[Any] = []
+        self._idmap: Dict[int, int] = {}   # registry id -> ledger row
+        self._rids: List[int] = []         # ledger row -> registry id
+        self._prev_members = np.zeros(0, bool)
+        self._prev_seen = np.zeros(0, bool)
 
-    def _grow(self, k: int) -> None:
-        if k <= self.clients:
-            return
-        pad = k - self.clients
-        z = lambda: np.zeros(pad, np.float64)
-        if self.clients == 0:
-            for name in ("norm_sum", "norm_n", "nonfinite", "dist_sum",
-                         "dist_n", "loss_sum", "weight_sum", "active_rounds",
-                         "guard_checks", "guard_fails", "quar_rounds",
-                         "drops", "straggles", "corrupts", "arrivals",
-                         "admits", "rejects", "stale_sum", "bytes",
-                         "member_rounds", "joins", "leaves"):
-                setattr(self, name, z())
-        else:
-            for name in ("norm_sum", "norm_n", "nonfinite", "dist_sum",
-                         "dist_n", "loss_sum", "weight_sum", "active_rounds",
-                         "guard_checks", "guard_fails", "quar_rounds",
-                         "drops", "straggles", "corrupts", "arrivals",
-                         "admits", "rejects", "stale_sum", "bytes",
-                         "member_rounds", "joins", "leaves"):
-                setattr(self, name, np.concatenate([getattr(self, name), z()]))
-        self.clients = k
+    def _rows(self, rids: List[int]) -> np.ndarray:
+        """Ledger rows for this record's ids, allocating new rows (and
+        growing every aggregate array) for first-seen clients."""
+        pad = 0
+        for r in rids:
+            if r not in self._idmap:
+                self._idmap[r] = len(self._rids)
+                self._rids.append(r)
+                pad += 1
+        if pad:
+            z = lambda: np.zeros(pad, np.float64)
+            if self.clients == 0:
+                for name in _STATS:
+                    setattr(self, name, z())
+            else:
+                for name in _STATS:
+                    setattr(self, name,
+                            np.concatenate([getattr(self, name), z()]))
+            self._prev_members = np.concatenate(
+                [self._prev_members, np.zeros(pad, bool)])
+            self._prev_seen = np.concatenate(
+                [self._prev_seen, np.zeros(pad, bool)])
+            self.clients = len(self._rids)
+        return np.asarray([self._idmap[r] for r in rids], np.int64)
 
     def observe(self, rec: Dict[str, Any]) -> None:
         """Accumulate one record; ignores everything but ``client``."""
@@ -150,7 +184,13 @@ class ClientLedger:
         k = int(rec.get("clients", 0))
         if k <= 0:
             return
-        self._grow(k)
+        reg = rec.get("registry_ids")
+        if isinstance(reg, list) and len(reg) == k:
+            rids = [int(r) for r in reg]
+            self.sparse = True
+        else:
+            rids = list(range(k))
+        idx = self._rows(rids)
         self.records += 1
         self._rounds.append(int(rec.get("round_index", -1)))
 
@@ -160,7 +200,6 @@ class ClientLedger:
                 return default
             return np.asarray(v, np.float64)
 
-        idx = np.arange(k)
         norm = arr("update_norm")
         if norm is not None:
             finite = np.isfinite(norm)
@@ -179,28 +218,28 @@ class ClientLedger:
         active = arr("active")
         act = (active > 0) if active is not None else np.zeros(k, bool)
         if active is not None:
-            self.active_rounds += act.astype(np.float64)
+            self.active_rounds[idx] += act.astype(np.float64)
         weight = arr("weight")
         if weight is not None:
-            self.weight_sum += weight
+            self.weight_sum[idx] += weight
         gok = arr("guard_ok")
         gfail = np.zeros(k, bool)
         if gok is not None and active is not None:
             gfail = act & (gok < 0.5)
-            self.guard_checks += act.astype(np.float64)
-            self.guard_fails += gfail.astype(np.float64)
+            self.guard_checks[idx] += act.astype(np.float64)
+            self.guard_fails[idx] += gfail.astype(np.float64)
         quar = arr("quarantine")
         quarm = (quar > 0) if quar is not None else np.zeros(k, bool)
-        self.quar_rounds += quarm.astype(np.float64)
+        self.quar_rounds[idx] += quarm.astype(np.float64)
         drop = arr("dropped")
         strag = arr("straggled")
         corr = arr("corrupted")
         dropm = (drop > 0) if drop is not None else np.zeros(k, bool)
         stragm = (strag > 0) if strag is not None else np.zeros(k, bool)
         corrm = (corr > 0) if corr is not None else np.zeros(k, bool)
-        self.drops += dropm.astype(np.float64)
-        self.straggles += stragm.astype(np.float64)
-        self.corrupts += corrm.astype(np.float64)
+        self.drops[idx] += dropm.astype(np.float64)
+        self.straggles[idx] += stragm.astype(np.float64)
+        self.corrupts[idx] += corrm.astype(np.float64)
         stale = arr("staleness")
         admitted = arr("admitted")
         rejm = np.zeros(k, bool)
@@ -208,27 +247,34 @@ class ClientLedger:
             arrived = stale >= 0
             adm = (admitted > 0) if admitted is not None else arrived
             rejm = arrived & ~adm
-            self.arrivals += arrived.astype(np.float64)
-            self.admits += (arrived & adm).astype(np.float64)
-            self.rejects += rejm.astype(np.float64)
+            self.arrivals[idx] += arrived.astype(np.float64)
+            self.admits[idx] += (arrived & adm).astype(np.float64)
+            self.rejects[idx] += rejm.astype(np.float64)
             self.stale_sum[idx[arrived & adm]] += stale[arrived & adm]
         pb = rec.get("payload_bytes")
         if isinstance(pb, (int, float)) and not isinstance(pb, bool):
-            self.bytes += float(pb) * act.astype(np.float64)
+            self.bytes[idx] += float(pb) * act.astype(np.float64)
         members = arr("members")
         outm = np.zeros(k, bool)
         if members is not None:
             mem = members > 0
             outm = ~mem
-            self.member_rounds += mem.astype(np.float64)
-            if self._prev_members is not None and \
-                    self._prev_members.shape[0] == k:
-                self.joins += (mem & ~self._prev_members).astype(np.float64)
-                self.leaves += (~mem & self._prev_members).astype(np.float64)
-            self.joins += 0.0      # keep dtype float64 under += of bools
-            self._prev_members = mem
-        elif self._prev_members is None:
-            self._prev_members = np.ones(k, bool)
+            self.member_rounds[idx] += mem.astype(np.float64)
+            # join/leave transitions only for rows with a known previous
+            # state: a first sighting is baseline, not a transition —
+            # exactly the old dense behaviour (no counting on record 1)
+            seen = self._prev_seen[idx]
+            prev = self._prev_members[idx]
+            self.joins[idx[seen & mem & ~prev]] += 1.0
+            self.leaves[idx[seen & ~mem & prev]] += 1.0
+            self._prev_members[idx] = mem
+            self._prev_seen[idx] = True
+        else:
+            # no churn field: first-seen rows default to member (the
+            # old `ones(k)` baseline), known rows keep their last state
+            fresh = idx[~self._prev_seen[idx]]
+            self._prev_members[fresh] = True
+            self._prev_seen[fresh] = True
 
         # one glyph per client for the timeline view (priority order)
         nonfin = (~np.isfinite(norm)) if norm is not None \
@@ -254,7 +300,7 @@ class ClientLedger:
             else:
                 g = "-"
             row.append(g)
-        self._glyphs.append(row)
+        self._glyphs.append((idx, row))
 
     # -- derived statistics ---------------------------------------------
 
@@ -293,16 +339,25 @@ class ClientLedger:
         nonfin_rate = self._rate(self.nonfinite, nobs)
         return z_norm + z_stale + 4.0 * gfail_rate + 4.0 * nonfin_rate
 
+    def ids(self) -> List[int]:
+        """Observed client (registry) ids, ascending; dense streams
+        yield ``0..K-1``."""
+        return sorted(self._rids)
+
     def ranking(self) -> List[Dict[str, Any]]:
-        """Clients sorted by anomaly score (desc), ties by id (asc)."""
+        """Clients sorted by anomaly score (desc), ties by id (asc).
+
+        ``client`` is the REGISTRY id (== the dense slot id on
+        non-population streams)."""
         scores = self.anomaly_scores()
-        order = np.lexsort((np.arange(self.clients), -scores))
+        rids = np.asarray(self._rids, np.int64).reshape(-1)
+        order = np.lexsort((rids, -scores))
         mean_norm = self.mean_norms()
         out = []
         for i in order:
             i = int(i)
             out.append({
-                "client": i,
+                "client": int(rids[i]),
                 "score": float(scores[i]),
                 "mean_norm": (None if not np.isfinite(mean_norm[i])
                               else float(mean_norm[i])),
@@ -324,11 +379,12 @@ class ClientLedger:
         mean_norm = self.mean_norms()
         finite = mean_norm[np.isfinite(mean_norm)]
         scores = self.anomaly_scores()
-        top = int(np.lexsort((np.arange(self.clients), -scores))[0])
+        rids = np.asarray(self._rids, np.int64).reshape(-1)
+        top = int(np.lexsort((rids, -scores))[0])
         out: Dict[str, Any] = {
             "client_records": self.records,
             "clients_observed": self.clients,
-            "top_offender": top,
+            "top_offender": int(rids[top]),
             "top_offender_score": float(scores[top]),
         }
         if finite.size:
@@ -351,31 +407,41 @@ class ClientLedger:
         out = []
         scores = self.anomaly_scores()
         mean_norm = self.mean_norms()
+        rids = np.asarray(self._rids, np.int64).reshape(-1)
+        order = np.argsort(rids, kind="stable")   # rows in id order
         bounds = [round(j * k / n) for j in range(n + 1)]
         for j in range(n):
             lo, hi = bounds[j], bounds[j + 1]
             if hi <= lo:
                 continue
-            sl = slice(lo, hi)
-            mn = mean_norm[sl]
+            rows = order[lo:hi]
+            mn = mean_norm[rows]
             mn = mn[np.isfinite(mn)]
             out.append({
                 "cohort": j,
-                "clients": f"{lo}..{hi - 1}",
+                "clients": f"{rids[rows[0]]}..{rids[rows[-1]]}",
                 "mean_norm": float(np.mean(mn)) if mn.size else None,
-                "faults": int(self.drops[sl].sum()
-                              + self.straggles[sl].sum()
-                              + self.corrupts[sl].sum()),
-                "guard_fails": int(self.guard_fails[sl].sum()),
-                "bytes": int(self.bytes[sl].sum()),
-                "score_max": float(np.max(scores[sl])),
+                "faults": int(self.drops[rows].sum()
+                              + self.straggles[rows].sum()
+                              + self.corrupts[rows].sum()),
+                "guard_fails": int(self.guard_fails[rows].sum()),
+                "bytes": int(self.bytes[rows].sum()),
+                "score_max": float(np.max(scores[rows])),
             })
         return out
 
     def timelines(self) -> List[str]:
-        """One glyph string per client, rounds left to right."""
-        return ["".join(row[i] for row in self._glyphs)
-                for i in range(self.clients)]
+        """One glyph string per client (ascending id — :meth:`ids`
+        order), rounds left to right; '-' where a client was not in
+        that round's record (population mode: not sampled)."""
+        cols = []
+        for idx, row in self._glyphs:
+            col = np.full(self.clients, "-", dtype="<U1")
+            col[idx] = row
+            cols.append(col)
+        rids = np.asarray(self._rids, np.int64).reshape(-1)
+        order = np.argsort(rids, kind="stable")
+        return ["".join(col[i] for col in cols) for i in order]
 
 
 def ledger_from_records(records: Sequence[Dict[str, Any]]) -> ClientLedger:
@@ -396,13 +462,19 @@ def format_clients(led: ClientLedger, *, top: int = 10,
     if led.records == 0:
         return "no client records in stream (client_ledger off, or a " \
                "pre-v10 artifact)"
-    lines = [f"client ledger: K={led.clients}, {led.records} round "
-             f"record(s)"]
+    if led.sparse:
+        lines = [f"client ledger: {led.clients} registry client(s) "
+                 f"observed (sparse cohorts), {led.records} round "
+                 f"record(s)"]
+    else:
+        lines = [f"client ledger: K={led.clients}, {led.records} round "
+                 f"record(s)"]
     lines.append("  timeline glyphs: " + " ".join(
         f"{g}={name}" for name, g in _GLYPHS))
     tls = led.timelines()
-    width = max(len(str(led.clients - 1)), 2)
-    for i, tl in enumerate(tls):
+    ids = led.ids()
+    width = max(len(str(max(ids))), 2)
+    for i, tl in zip(ids, tls):
         lines.append(f"  c{i:<{width}} |{tl}|")
     rank = led.ranking()
     lines.append(f"anomaly ranking (top {min(top, len(rank))}; "
@@ -523,6 +595,26 @@ def selftest() -> str:
         tls = led.timelines()
         assert tls[2][0] == "C", tls     # corrupted glyph wins
         assert tls[3][1] == "S", tls     # straggle on round 1
+
+    # sparse population cohorts (schema v11): each record carries only
+    # the sampled cohort, keyed by registry id — the ledger grows to
+    # the clients ever seen and '-' fills unsampled rounds
+    nan = float("nan")
+    recs = [dict(event="client", schema=11, run_id="x", round_index=0,
+                 clients=2, registry_ids=[3, 900],
+                 update_norm=[1.0, 1.0], active=[1.0, 1.0]),
+            dict(event="client", schema=11, run_id="x", round_index=1,
+                 clients=2, registry_ids=[3, 41],
+                 update_norm=[1.0, nan], active=[1.0, 1.0])]
+    sled = ledger_from_records(recs)
+    assert sled.sparse and sled.clients == 3
+    assert sled.ids() == [3, 41, 900]
+    assert sled.ranking()[0]["client"] == 41          # NaN shipper, by rid
+    assert sled.summary_fields()["top_offender"] == 41
+    tl = dict(zip(sled.ids(), sled.timelines()))
+    assert tl[3] == ".." and tl[41] == "-C" and tl[900] == ".-", tl
+    assert (ledger_from_records(recs).anomaly_scores().tobytes()
+            == sled.anomaly_scores().tobytes())
     return "obs clients selftest: OK (NaN client ranks first; replayable)"
 
 
@@ -540,7 +632,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="also print an N-cohort contiguous rollup")
     p.add_argument("--expect-top", type=int, default=None, metavar="ID",
                    help="exit 2 unless the anomaly rank-1 client is ID "
-                        "(CI assertion hook)")
+                        "(CI assertion hook; ID is the REGISTRY id on "
+                        "population streams)")
     p.add_argument("--json", action="store_true",
                    help="print {ranking, summary, cohorts} as one JSON "
                         "object (deterministic: byte-identical across "

@@ -212,10 +212,14 @@ def load_source(path: str) -> Dict[str, Any]:
             for k, val in obj.items():
                 # smoke_* covers bench.py --smoke fields: the *_wire_bytes
                 # ones gate (direction -1), the rest report as info
+                # population_* covers bench.py --population-bench: the
+                # *_throughput and *_savings_ratio fields gate by suffix
+                # rule, the K/cohort/wall fields report as info
                 if (k.endswith("_ips_chip") or k == "mfu"
                         or k.endswith("_wire_bytes")
                         or k.endswith("_savings_ratio")
-                        or k.startswith("smoke_")):
+                        or k.startswith("smoke_")
+                        or k.startswith("population_")):
                     v = _num(val)
                     if v is not None:
                         src["metrics"][k] = v

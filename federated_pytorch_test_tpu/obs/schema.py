@@ -131,8 +131,17 @@ from typing import Any, Dict
 # byte-identical to v9.  The record is derived from host values the
 # engine already fetched plus one optional probe output, and the
 # anomaly ranking in obs/clients.py is a pure function of the stream.
-# v1..v9 records remain valid: validate_record accepts ver <= SCHEMA_VERSION.
-SCHEMA_VERSION = 10
+# v11 (additive): population federation (population/) — `client` records
+# gain optional `registry_ids`, a parallel length-`clients` list mapping
+# each slot to the REGISTRY id of the virtual client that occupied it
+# this round (``--population K`` decouples registered clients from
+# device slots; the sampled cohort changes every round).  When present,
+# obs/clients.py keys its ledger/ranking/timelines by registry id and
+# aggregates byte-exactly over the full population even though each
+# record only carries the sampled cohort.  Absent on population-off
+# streams, which therefore stay byte-identical to v10.
+# v1..v10 records remain valid: validate_record accepts ver <= SCHEMA_VERSION.
+SCHEMA_VERSION = 11
 
 EVENTS = ("run_header", "round", "summary", "span", "alert", "compile",
           "control", "client")
@@ -305,6 +314,7 @@ FIELDS: Dict[str, Any] = {
     "staleness":    (("client",), _LIST),     # async: rounds stale
     "admitted":     (("client",), _LIST),     # async: admission outcome
     "members":      (("client",), _LIST),     # churn roster after tick
+    "registry_ids": (("client",), _LIST),     # population: slot -> rid (v11)
     "payload_bytes": (("client",), _INT),     # uplink bytes/participant
     # summary totals / rates
     "status":       (("summary",), _STR),

@@ -115,6 +115,15 @@ def initialize_multihost() -> bool:
     env vars).  Call BEFORE any device query.  Returns True when running
     multi-process afterwards.  A no-op (False) when unset, so single-host
     behavior — every test, bench, and dry run — is unchanged.
+
+    Explicit coordination hook (population-scale pods / CPU or GPU
+    process launches, where there is no TPU metadata server to
+    auto-discover from): ``FEDTPU_COORDINATOR=host:port`` plus
+    ``FEDTPU_NUM_PROCESSES`` and ``FEDTPU_PROCESS_ID`` pass straight
+    through to ``jax.distributed.initialize(coordinator_address=...,
+    num_processes=..., process_id=...)``.  Set all three or none —
+    a partial set is a config error and raises here, not as a hang at
+    the first collective.
     """
     import os
 
@@ -123,10 +132,26 @@ def initialize_multihost() -> bool:
         # backend and defeat a later platform override (--no-use-tpu)
         return False
     if not jax.distributed.is_initialized():
+        coord = os.environ.get("FEDTPU_COORDINATOR")
+        nproc = os.environ.get("FEDTPU_NUM_PROCESSES")
+        pid = os.environ.get("FEDTPU_PROCESS_ID")
+        explicit = (coord, nproc, pid)
+        if any(v is not None for v in explicit) \
+                and not all(v is not None for v in explicit):
+            raise ValueError(
+                "FEDTPU_COORDINATOR, FEDTPU_NUM_PROCESSES and "
+                "FEDTPU_PROCESS_ID must be set together (got "
+                f"coordinator={coord!r}, num_processes={nproc!r}, "
+                f"process_id={pid!r})")
         # genuine init failures (unreachable coordinator, ...) must raise:
         # a worker silently proceeding single-process while its peers
         # joined the global mesh hangs at the first collective instead
-        jax.distributed.initialize()
+        if coord is not None:
+            jax.distributed.initialize(coordinator_address=coord,
+                                       num_processes=int(nproc),
+                                       process_id=int(pid))
+        else:
+            jax.distributed.initialize()
     return jax.process_count() > 1
 
 

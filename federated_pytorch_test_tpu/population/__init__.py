@@ -1,0 +1,23 @@
+"""Population federation: virtual-client registry + seeded cohort sampling.
+
+Decouples the REGISTERED client count (``cfg.population``, target 10k+)
+from the compiled cohort size (``cfg.K``, still sharded over the device
+mesh).  ``sampler`` draws each round's cohort as a pure function of
+(seed, round coordinates); ``registry`` keeps the per-client host state
+(quarantine, membership, async ledger, EF/compressor rows) for every
+registered client and stitches it through checkpoints.
+"""
+
+from federated_pytorch_test_tpu.population.registry import ClientRegistry
+from federated_pytorch_test_tpu.population.sampler import (
+    SAMPLER_CHOICES,
+    cohort_slot_mask,
+    sample_cohort,
+)
+
+__all__ = [
+    "ClientRegistry",
+    "SAMPLER_CHOICES",
+    "cohort_slot_mask",
+    "sample_cohort",
+]

@@ -63,6 +63,33 @@ class FederatedConfig:
     # client moves every round).
     participation: float = 1.0
 
+    # population federation (population/): register `population` virtual
+    # clients (target 10k+) while the device mesh still compiles over K
+    # slots — each communication round a seeded sampler draws a K-id
+    # cohort (a pure function of seed + round coordinates, so kill/
+    # resume and mesh reshape redraw the identical sequence, replayable
+    # via control.replay), the round kernel gathers the cohort's
+    # registry state (quarantine, membership, async ledger, EF rows)
+    # into its [K] slot arrays, and the slots scatter back afterwards —
+    # per-round cost is cohort-bounded, not population-bounded.  0 = off
+    # (the literal pre-population engine, bitwise); population == K is
+    # full participation and also bitwise the existing engine.
+    # Requires population >= K; incompatible with bb_update (slot
+    # occupancy changes per round, breaking the BB spectral history),
+    # biased_input, fused_rounds, device_data and overlap_staging (the
+    # cohort's data rows are re-indexed on the host staging path).
+    population: int = 0
+    # cohort sampling method (population/sampler.py SAMPLER_CHOICES):
+    # uniform | weighted (static seeded availability weights) |
+    # stratified (one id per contiguous id stratum — guaranteed spread)
+    cohort_sampling: str = "uniform"
+    # live cohort-size knob: the fraction of the K cohort slots active
+    # per round (>= 1/K; seeded slot choice).  The control plane's
+    # cohort rung shrinks this under throughput collapse and regrows it
+    # on quiet (control/policy.py); the restart supervisor's degraded
+    # ladder lowers it for population runs (control/supervisor.py).
+    cohort_frac: float = 1.0
+
     # lossy update compression (compress/): each comm round the client
     # ships encode(x_k - z) instead of the dense f32 block vector and the
     # server averages the reconstructions.  "none" = reference parity

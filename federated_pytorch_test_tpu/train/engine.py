@@ -210,6 +210,12 @@ class BlockwiseFederatedTrainer(RoundKernel):
                 "participation < 1 is incompatible with bb_update: the BB "
                 "spectral history (x0/yhat0 deltas) assumes every client "
                 "moves every round (consensus_multi.py:242-278)")
+        if self._pop_active and cfg.overlap_staging:
+            raise ValueError(
+                "overlap_staging is incompatible with population "
+                "sampling: the lookahead stages the NEXT round's batches "
+                "before that round's cohort is drawn, and the staged "
+                "rows would belong to the wrong registry clients")
         self.K_local = K // self.D
 
         # --- common init: all K clients start from identical weights
@@ -293,8 +299,12 @@ class BlockwiseFederatedTrainer(RoundKernel):
         self.test_y = stage_global(yt, rsh)          # [tsteps, B] i32
         self.test_w = stage_global(wt, rsh)          # [tsteps, B] f32
         self.test_n = int(wt.sum())                  # true test sample count
+        # host copy kept for population mode: slot k's normalisation
+        # stats follow the cohort's data shard (rid % K), restaged per
+        # round in _run_impl (population off never touches it again)
+        self._client_norm_host = np.asarray(data.norm_stats, np.float32)
         self.client_norm = stage_global(
-            np.asarray(data.norm_stats, np.float32), csh  # [K, 2, 3]
+            self._client_norm_host, csh                  # [K, 2, 3]
         )
         # the kernel's per-run constant masks (full-participation ones
         # mask, zero corruption vector, +inf guard bound), staged once
@@ -318,6 +328,8 @@ class BlockwiseFederatedTrainer(RoundKernel):
             import warnings
             why = ("be_verbose syncs the host every epoch"
                    if cfg.be_verbose else
+                   "population sampling re-indexes epoch data on the host"
+                   if self._pop_active else
                    "epoch data is not device-resident (device_data)")
             warnings.warn(
                 f"fused_rounds requested but unusable: {why}; "
@@ -502,8 +514,14 @@ class BlockwiseFederatedTrainer(RoundKernel):
         # round's arrivals (_round_activity_async).
         faults_on = self.faults.enabled
         guard_on = cfg.update_guard
+        # population sampling makes every round partial too: the cohort
+        # rung can mask slots out, so the aggregation must renormalize
+        # over the activity vector.  population == K (identity) keeps
+        # the unmasked program — the bitwise full-participation contract.
+        pop_partial = (getattr(cfg, "population", 0) > 0
+                       and cfg.population != cfg.K)
         partial = (cfg.participation < 1.0 or faults_on or guard_on
-                   or cfg.async_rounds)
+                   or cfg.async_rounds or pop_partial)
         has_corrupt = faults_on and self.faults.corrupt > 0
         corrupt_mode, corrupt_scale = self.faults.mode, self.faults.scale
         mean_fn = self.mean_fn
@@ -1001,6 +1019,18 @@ class BlockwiseFederatedTrainer(RoundKernel):
         want = self.cfg.device_data
         if want is False:
             return False
+        if self._pop_active:
+            # population sampling re-indexes every epoch's batches by
+            # the round's cohort on the HOST (slot k reads registry
+            # client cohort[k]'s shard); the device-resident gather has
+            # no cohort input, so auto resolves to off
+            if want:
+                raise ValueError(
+                    "device_data=True is incompatible with population "
+                    "sampling: epoch batches are re-indexed by the "
+                    "round's cohort on the host (only auto/False are "
+                    "valid here)")
+            return False
         if not hasattr(self.data, "train_shards_raw"):
             if want:      # an explicit True that cannot be honored: say so
                 raise ValueError(
@@ -1064,6 +1094,17 @@ class BlockwiseFederatedTrainer(RoundKernel):
         else:                        # first epoch / after resume: build now
             xb, yb, wb = self._host_epoch(c)
         self._pending = None
+        if self._pop_active and self._cohort is not None:
+            # population re-index: slot k trains on registry client
+            # cohort[k]'s data shard (rid % K — the K on-disk shards are
+            # shared round-robin across the registered id space, the
+            # standard simulation regime for K ≫ dataset partitions).
+            # Applied at CONSUMPTION, after the counter-keyed prefetch
+            # future resolves, so the prefetch stays cohort-free and a
+            # resumed run re-derives the identical rows from the cohort
+            # it restored.
+            rows = (self._cohort % self.cfg.K).astype(np.int64)
+            xb, yb, wb = xb[rows], yb[rows], wb[rows]
         if self._prefetch_epochs and not last:
             # overlap epoch c+1's permutation/gather with this round's
             # device compute; the counter-keyed seed makes the result
@@ -1162,6 +1203,58 @@ class BlockwiseFederatedTrainer(RoundKernel):
         if host is None:                   # stateless compressor (plain topk)
             return None
         return stage_tree_global(host, client_sharding(self.mesh))
+
+    def _fresh_comp_host(self, ci: Optional[int]):
+        """Host-side fresh [K]-stacked compressor state for block ``ci``
+        — the un-staged twin of ``_init_comp_state`` (same seed recipe),
+        cached per block: the population comp-row rotation consults the
+        fresh rows every round."""
+        key = (0 if ci is None else ci, self.cfg.compress)
+        cached = getattr(self, "_pop_comp_fresh", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        seed = int(np.random.default_rng(
+            [self.cfg.seed, 23, 0 if ci is None else ci]).integers(2**31))
+        host = stacked_init(self.compressor, self.cfg.K,
+                            self.block_size(ci), seed)
+        self._pop_comp_fresh = (key, host)
+        return host
+
+    def _population_swap_comp(self, comp, ci: Optional[int]):
+        """Rotate the [K]-stacked compressor/EF rows to this round's
+        cohort (population mode): stash the previous cohort's rows in
+        the registry, rebuild the stack as each new member's stored row
+        (if it was sampled before this block) or the block's fresh init
+        row for the slot it landed in, and restage.  A host round trip —
+        population rounds already pay a host boundary for the cohort
+        gather, and the comp state is [K, ~N] small next to the epoch
+        data.  This is what makes EF residuals PER-CLIENT state: a
+        client resuming after rounds unsampled carries on from its own
+        residual, not whatever its slot last held."""
+        reg = self._registry
+        cohort = self._cohort
+        if (self._pop_comp_prev is not None
+                and np.array_equal(self._pop_comp_prev, cohort)):
+            return comp              # same cohort: rows already in place
+        if self._pop_comp_prev is None and reg.comp_rows == 0:
+            # first round of the block: the live state IS the fresh init
+            self._pop_comp_prev = cohort.copy()
+            return comp
+        leaves = [np.asarray(fetch(l)) for l in jax.tree.leaves(comp)]
+        treedef = jax.tree.structure(comp)
+        stacked = [l.ndim >= 1 and l.shape[0] == self.cfg.K
+                   for l in leaves]
+        if self._pop_comp_prev is not None:
+            reg.stash_comp_rows(self._pop_comp_prev, leaves, stacked)
+        fresh_leaves = [np.asarray(l)
+                        for l in jax.tree.leaves(self._fresh_comp_host(ci))]
+        out = reg.load_comp_rows(cohort, fresh_leaves, stacked)
+        # block-global (non-client-stacked) leaves keep their live values
+        out = [o if is_k else cur
+               for o, cur, is_k in zip(out, leaves, stacked)]
+        self._pop_comp_prev = cohort.copy()
+        return stage_tree_global(jax.tree.unflatten(treedef, out),
+                                 client_sharding(self.mesh))
 
     def _init_sparse_scratch(self, N: int):
         """Zeroed [K, N] accumulator the sparse top-k comm step scatters
@@ -1600,6 +1693,19 @@ class BlockwiseFederatedTrainer(RoundKernel):
                         active, comm_active, corrupt, comm_host, fcounts = \
                             self._round_activity(nloop, ci, nadmm)
                         n_comm = fcounts.pop("n_comm", 1)
+                        cnorm = self.client_norm
+                        if self._pop_active:
+                            # the cohort just rotated: move per-client
+                            # compressor/EF rows to the new members and
+                            # re-point slot norm stats at the cohort's
+                            # data shards (rid % K, like _build_epoch)
+                            if jax.tree.leaves(state.comp):
+                                state = state._replace(
+                                    comp=self._population_swap_comp(
+                                        state.comp, ci))
+                            rows = (self._cohort % cfg.K).astype(np.int64)
+                            cnorm = stage_global(
+                                self._client_norm_host[rows], csh)
                         if (self.faults.churn_enabled
                                 and self._rejoined_mask.any()
                                 and jax.tree.leaves(state.comp)):
@@ -1682,7 +1788,7 @@ class BlockwiseFederatedTrainer(RoundKernel):
                                     phase_marks.append(
                                         ("stage", "phase", t_stage, now))
                                 state, losses = train_epoch(
-                                    state, y, self.client_norm, keys,
+                                    state, y, cnorm, keys,
                                     xb, yb, wb, z, rho, active)
                                 self._host_dispatches += 1
                                 loss_acc = (losses if loss_acc is None

@@ -121,6 +121,33 @@ class RoundKernel:
         self._members_joined = 0
         self._members_left = 0
         self._preempt_armed = True
+        # population federation (population/): cfg.population registered
+        # virtual clients, cfg.K device slots.  The registry keeps the
+        # [population] ledgers; every round _population_round_begin
+        # scatters the previous cohort's slot rows back and gathers the
+        # new cohort's rows into the SAME [K] slot arrays above, so the
+        # whole robustness shell runs unchanged over slots.  None when
+        # population is off; an identity registry (population == K) is
+        # constructed but inert — every branch below checks
+        # ``not identity``, which is the bitwise K=D contract.
+        self._registry = None
+        self._cohort = None                  # this round's sorted rids
+        self._pop_slot_mask = None           # control-plane cohort mask
+        self._cohort_frac = float(getattr(cfg, "cohort_frac", 1.0))
+        self._pop_comp_prev = None           # cohort owning state.comp rows
+        pop = int(getattr(cfg, "population", 0))
+        if pop:
+            from federated_pytorch_test_tpu.population import ClientRegistry
+            self._registry = ClientRegistry(
+                pop, cfg.K, cfg.seed,
+                sampling=getattr(cfg, "cohort_sampling", "uniform"))
+
+    @property
+    def _pop_active(self) -> bool:
+        """Population mode live (registered clients ≫ cohort)?  False for
+        both population-off and the identity registry, so every guarded
+        branch degenerates to the literal pre-population code."""
+        return self._registry is not None and not self._registry.identity
 
     def _stage_round_constants(self) -> None:
         """Stage the per-run constant masks once (call after the mesh
@@ -164,6 +191,32 @@ class RoundKernel:
         if cfg.quarantine_rounds < 0:
             raise ValueError(
                 f"quarantine_rounds={cfg.quarantine_rounds} must be >= 0")
+        pop = int(getattr(cfg, "population", 0))
+        if pop < 0:
+            raise ValueError(f"population={pop} must be >= 0 (0 = off)")
+        if pop:
+            if pop < cfg.K:
+                raise ValueError(
+                    f"population={pop} must be >= K={cfg.K}: the cohort "
+                    "fills every device slot each round (use "
+                    "population=0 to turn virtualization off)")
+            if cfg.bb_update and pop != cfg.K:
+                raise ValueError(
+                    "population sampling is incompatible with bb_update: "
+                    "the BB spectral history assumes the SAME clients "
+                    "move every round (consensus_multi.py:242-278), and "
+                    "a rotating cohort re-seats the [K] slots")
+            from federated_pytorch_test_tpu.population.sampler import (
+                SAMPLER_CHOICES)
+            if getattr(cfg, "cohort_sampling",
+                       "uniform") not in SAMPLER_CHOICES:
+                raise ValueError(
+                    f"cohort_sampling={cfg.cohort_sampling!r} must be "
+                    f"one of {SAMPLER_CHOICES}")
+        frac = float(getattr(cfg, "cohort_frac", 1.0))
+        if not 0.0 < frac <= 1.0:
+            raise ValueError(
+                f"cohort_frac={frac} must be in (0, 1]")
         from federated_pytorch_test_tpu.obs.health import HEALTH_ACTIONS
         if cfg.health_action not in HEALTH_ACTIONS:
             raise ValueError(
@@ -212,14 +265,70 @@ class RoundKernel:
     def _participation_host(self, nloop: int, ci: int, nadmm: int):
         """Host [K] f32 participation draw for this round — STATELESSLY
         keyed on the round coordinates, so a resumed run redraws the
-        identical masks — with at least one participant guaranteed."""
+        identical masks — with at least one participant guaranteed.
+
+        Under population mode the Bernoulli is drawn per REGISTRY id
+        (the whole [population] vector, then the cohort's rows), so
+        whether client rid participates is a property of rid and the
+        round, not of which slot it landed in — and the population == K
+        identity cohort (``arange(K)``) reads back the exact seed-path
+        vector."""
         rng = np.random.default_rng(
             [self.cfg.seed, 11, nloop, ci, nadmm])
-        m = (rng.random(self.cfg.K)
-             < self.cfg.participation).astype(np.float32)
+        if self._pop_active:
+            mP = (rng.random(self._registry.population)
+                  < self.cfg.participation).astype(np.float32)
+            m = mP[self._cohort]
+        else:
+            m = (rng.random(self.cfg.K)
+                 < self.cfg.participation).astype(np.float32)
         if not m.any():
             m[int(rng.integers(self.cfg.K))] = 1.0
         return m
+
+    def _population_round_begin(self, nloop: int, ci: int,
+                                nadmm: int) -> None:
+        """Rotate the registry cohort for this round (population mode).
+
+        Scatters the PREVIOUS cohort's slot ledgers back to their
+        registry rows, draws this round's cohort (a pure function of the
+        seed + round coordinates — sampler.py), and gathers the new
+        cohort's rows into the same [K] slot arrays the whole robustness
+        shell already runs over.  The round clock for the async
+        late-arrival clamp is ``nadmm`` (the within-block round index
+        the arrival schedule is expressed in)."""
+        if not self._pop_active:
+            return
+        reg = self._registry
+        if self._cohort is not None:
+            reg.scatter_ledgers(self._cohort, quarantine=self._quarantine,
+                                members=self._members,
+                                arrival=self._async_arrival,
+                                birth=self._async_birth)
+        ids, mask = reg.draw(nloop, ci, nadmm, self._cohort_frac)
+        led = reg.gather_ledgers(ids, nadmm)
+        self._cohort = ids
+        self._pop_slot_mask = mask
+        self._quarantine = led["quarantine"]
+        self._members = led["members"]
+        self._async_arrival = led["arrival"]
+        self._async_birth = led["birth"]
+
+    def _round_faults_cohort(self, nloop: int, ci: int, nadmm: int):
+        """This round's (drop, straggle, corrupt) [K] vectors.
+
+        Population mode draws the whole [population] fault vectors and
+        takes the cohort's rows — a fault is a property of the REGISTRY
+        client, not the slot it landed in, so `clients=` fault selectors
+        name registry ids and the identity cohort reads back the exact
+        seed-path draw (bitwise K=D contract)."""
+        faults = self.faults
+        if self._pop_active:
+            dP, sP, cP = faults.round_faults(
+                self._registry.population, nloop, ci, nadmm)
+            c = self._cohort
+            return dP[c], sP[c], cP[c]
+        return faults.round_faults(self.cfg.K, nloop, ci, nadmm)
 
     def _round_mask(self, nloop: int, ci: int, nadmm: int):
         """[K] f32 activity mask for this communication round.
@@ -270,6 +379,9 @@ class RoundKernel:
         round's FRACTIONAL staleness weights instead of a 0/1 mask.
         """
         cfg, faults = self.cfg, self.faults
+        # population mode: rotate the registry cohort FIRST — every
+        # ledger the tick/draws below read is a cohort slot view
+        self._population_round_begin(nloop, ci, nadmm)
         # the churn ledger ticks exactly once per round, BEFORE the async
         # delegation, so both schedulers see the same membership
         churn_counts = self._membership_tick(nloop, ci, nadmm)
@@ -277,7 +389,8 @@ class RoundKernel:
             return self._round_activity_async(nloop, ci, nadmm,
                                               churn_counts)
         quarantined = int(np.sum(self._quarantine > 0))
-        if not faults.enabled and quarantined == 0:
+        if (not faults.enabled and quarantined == 0
+                and self._pop_slot_mask is None):
             if cfg.participation >= 1.0:
                 dev, host = self._ones_mask, np.ones(cfg.K, np.float32)
             else:
@@ -288,6 +401,10 @@ class RoundKernel:
             return dev, dev, self._zero_corrupt, host, {}
         base = (np.ones(cfg.K, np.float32) if cfg.participation >= 1.0
                 else self._participation_host(nloop, ci, nadmm))
+        if self._pop_slot_mask is not None:
+            # control-plane cohort rung: inactive slots sit the round
+            # out entirely (same non-participant semantics as sampling)
+            base = base * self._pop_slot_mask
         if faults.churn_enabled:
             # a departed client is out of the round entirely — not
             # sampled, not faulted, not counted; the mean renormalizes
@@ -296,8 +413,8 @@ class RoundKernel:
         ok = 1.0 - (self._quarantine > 0).astype(np.float32)
         drop = straggle = corrupt = np.zeros(cfg.K, np.float32)
         if faults.enabled:
-            drop, straggle, corrupt = faults.round_faults(
-                cfg.K, nloop, ci, nadmm)
+            drop, straggle, corrupt = self._round_faults_cohort(
+                nloop, ci, nadmm)
         comm = base * ok * (1.0 - drop)
         train = comm * (1.0 - straggle)
         corrupt = corrupt * comm
@@ -338,6 +455,36 @@ class RoundKernel:
         faults = self.faults
         if not faults.churn_enabled:
             return {}
+        if self._pop_active:
+            # population mode ticks the WHOLE registry roster: churn is
+            # a property of registry clients, sampled or not, so the
+            # membership trajectory is independent of the cohort draw.
+            # The slot views refresh from the registry rows afterwards
+            # (a departed cohort member leaves mid-round like any other
+            # departure; the gather's late-arrival clamp is idempotent).
+            reg = self._registry
+            prevP = reg.members.copy()
+            newP = faults.round_churn(prevP, nloop, ci, nadmm)
+            joinedP = newP & ~prevP
+            leftP = prevP & ~newP
+            reg.members = newP
+            if leftP.any():
+                reg.quarantine[leftP] = 0
+                reg.async_arrival[leftP] = -1
+                reg.async_birth[leftP] = 0
+                reg.drop_comp_rows(leftP)
+            c = self._cohort
+            led = reg.gather_ledgers(c, nadmm)
+            self._quarantine = led["quarantine"]
+            self._members = led["members"]
+            self._async_arrival = led["arrival"]
+            self._async_birth = led["birth"]
+            self._rejoined_mask = joinedP[c]
+            self._members_joined += int(joinedP.sum())
+            self._members_left += int(leftP.sum())
+            return {"members_active": int(newP.sum()),
+                    "joined": int(joinedP.sum()),
+                    "left": int(leftP.sum())}
         prev = self._members
         self._members = faults.round_churn(prev, nloop, ci, nadmm)
         joined = self._members & ~prev
@@ -430,6 +577,10 @@ class RoundKernel:
         K = cfg.K
         base = (np.ones(K, np.float32) if cfg.participation >= 1.0
                 else self._participation_host(nloop, ci, nadmm))
+        if self._pop_slot_mask is not None:
+            # cohort rung: an inactive slot neither dispatches nor has
+            # anything in flight voided — its ledger rows just sit
+            base = base * self._pop_slot_mask
         if faults.churn_enabled:
             # departed clients neither dispatch nor deliver (the
             # membership tick already voided their in-flight slots)
@@ -437,8 +588,8 @@ class RoundKernel:
         ok = 1.0 - (self._quarantine > 0).astype(np.float32)
         drop = straggle = corrupt = np.zeros(K, np.float32)
         if faults.enabled:
-            drop, straggle, corrupt = faults.round_faults(
-                K, nloop, ci, nadmm)
+            drop, straggle, corrupt = self._round_faults_cohort(
+                nloop, ci, nadmm)
         free = (self._async_arrival < 0).astype(np.float32)
         # dispatchers: free clients sampled this round that didn't drop.
         # A straggler still dispatches — its training is withheld, so the
@@ -446,7 +597,13 @@ class RoundKernel:
         # update semantics, now also late).
         dispatch = base * ok * (1.0 - drop) * free
         train = dispatch * (1.0 - straggle)
-        delays = faults.round_delays(K, nloop, ci, nadmm)
+        if self._pop_active:
+            # transit delays are a property of the registry client's
+            # link (the per-rid heterogeneity stream), not of the slot
+            delays = faults.round_delays(
+                self._registry.population, nloop, ci, nadmm)[self._cohort]
+        else:
+            delays = faults.round_delays(K, nloop, ci, nadmm)
         d_idx = dispatch > 0
         self._async_arrival[d_idx] = nadmm + delays[d_idx]
         self._async_birth[d_idx] = nadmm
@@ -527,6 +684,10 @@ class RoundKernel:
         self._quarantine = np.maximum(self._quarantine - 1, 0)
         if cfg.quarantine_rounds > 0:
             self._quarantine[tripped] = cfg.quarantine_rounds
+        if self._pop_active:
+            # advisory registry counters (telemetry only); the slot
+            # quarantine above scatters back at the next cohort rotation
+            self._registry.note_round(self._cohort, comm_host, tripped)
         if diag.get("n_ok", 0.0) > 0:
             nm = diag["guard_norm_mean"]
             self._guard_scale = (
@@ -569,6 +730,17 @@ class RoundKernel:
             meta["async_birth"] = np.asarray(self._async_birth, np.int64)
             meta["async_rejected"] = np.asarray(self._async_rejected,
                                                 np.int64)
+        if self._pop_active:
+            # registry ledgers ride the same meta (pop_* keys): scatter
+            # the live cohort's slot rows back first so the registry is
+            # self-consistent at the cut, and record whose rows the
+            # state tree's [K] stacks belong to (pop_cohort)
+            if self._cohort is not None:
+                self._registry.scatter_ledgers(
+                    self._cohort, quarantine=self._quarantine,
+                    members=self._members, arrival=self._async_arrival,
+                    birth=self._async_birth)
+            meta.update(self._registry.meta(self._cohort))
         return meta
 
     def _restore_ledger_meta(self, meta) -> None:
@@ -602,6 +774,15 @@ class RoundKernel:
                 self._members_joined = 0
                 self._members_left = 0
             self._rejoined_mask = np.zeros(self.cfg.K, bool)
+        if self._pop_active:
+            # registry restore AFTER the slot ledgers: the slot arrays
+            # above are the checkpointed cohort's rows, and pop_cohort
+            # says which rids they (and the state tree's comp rows)
+            # belong to.  A slot that predates population mode returns
+            # None — clean registry, first round draws cohort 0 fresh.
+            self._cohort = self._registry.restore(meta)
+            self._pop_comp_prev = self._cohort
+            self._pop_slot_mask = None
 
     def _reset_block_ledgers(self) -> None:
         """Block-boundary ledger reset: a fresh block means a fresh
@@ -612,6 +793,11 @@ class RoundKernel:
         self._guard_scale = float("inf")
         self._async_arrival = np.full(self.cfg.K, -1, np.int64)
         self._async_birth = np.zeros(self.cfg.K, np.int64)
+        if self._registry is not None:
+            # the registry's async ledger + per-block EF rows void with
+            # the block for the same reason the slot arrays do
+            self._registry.reset_block()
+            self._pop_comp_prev = None
 
     # ------------------------------------------------------------------
     # observability: recorder, client ledger, spans, health, control
@@ -685,6 +871,7 @@ class RoundKernel:
             dropped=cr.get("dropped"), straggled=cr.get("straggled"),
             corrupted=cr.get("corrupted"), staleness=cr.get("staleness"),
             admitted=cr.get("admitted"), members=cr.get("members"),
+            registry_ids=self._cohort if self._pop_active else None,
             payload_bytes=self.round_bytes_on_wire(N, 1))
         obs.client_event(fields)
         self._client_round = {}
@@ -821,6 +1008,19 @@ class RoundKernel:
                                            max_staleness=int(d.to_value))
                 log(f"control: {d.intervention} max_staleness "
                     f"{old} -> {self.cfg.max_staleness} ({d.reason})")
+            elif d.param == "cohort_frac":
+                # cohort-size rung: host-side knob read at the next
+                # cohort rotation (_population_round_begin) — no
+                # recompile, the compiled round stays [K]-shaped and
+                # inactive slots are masked out
+                if not self._pop_active:
+                    log("control: skip cohort_frac (population mode "
+                        "is off for this run)")
+                    continue
+                old_f = self._cohort_frac
+                self._cohort_frac = float(d.to_value)
+                log(f"control: {d.intervention} cohort_frac "
+                    f"{old_f} -> {self._cohort_frac} ({d.reason})")
         d = ctl.take_restart()
         if d is not None:
             from federated_pytorch_test_tpu.control.policy import (

@@ -135,6 +135,14 @@ class TestClassifierGolden:
         _check("classifier_fedavg_fused",
                _run_classifier(data, FedAvg(), fused_rounds=True))
 
+    def test_population_off_is_the_seed_path(self, data):
+        """``--population`` off (explicitly zeroed) must be the seed
+        path bit for bit: the golden generated before population/
+        existed still holds, proving the subsystem composes without
+        perturbing the default trajectory."""
+        _check("classifier_admm",
+               _run_classifier(data, AdmmConsensus(), population=0))
+
     def test_kill_resume_matches_uninterrupted(self, data, tmp_path):
         """Kill after round 1 (mid-block), resume in a fresh trainer:
         the combined trajectory must equal the UNINTERRUPTED golden."""

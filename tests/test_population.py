@@ -1,0 +1,368 @@
+"""Population federation (population/): registry + seeded cohort sampling.
+
+The subsystem's three contracts, each gated here:
+
+1. **Determinism** — the cohort draw is a pure function of (seed, round
+   coordinates): identical across processes, kill/resume, and mesh
+   reshapes, and re-derivable from a recorded stream's header config
+   alone (``control.replay.check_cohort_records``).
+2. **Identity** — ``population == K`` (full participation) is bitwise
+   the pre-population engine, and ``population = 0`` is the literal
+   seed path (tests/test_golden_trajectories.py holds the golden side).
+3. **Persistence** — registry ledgers and per-client compressor/EF rows
+   survive checkpoints: a killed-and-resumed population run is bitwise
+   the uninterrupted one.
+"""
+
+import hashlib
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import flax.linen as nn
+
+from federated_pytorch_test_tpu.control.policy import ControlPolicy
+from federated_pytorch_test_tpu.control.supervisor import (
+    _stage_reduced_cohort,
+)
+from federated_pytorch_test_tpu.data.cifar10 import FederatedCifar10
+from federated_pytorch_test_tpu.models.base import (
+    BlockModule,
+    elu,
+    flatten,
+    max_pool_2x2,
+    pairs,
+)
+from federated_pytorch_test_tpu.population import (
+    ClientRegistry,
+    SAMPLER_CHOICES,
+    cohort_slot_mask,
+    sample_cohort,
+)
+from federated_pytorch_test_tpu.population.sampler import client_weights
+from federated_pytorch_test_tpu.train import (
+    AdmmConsensus,
+    BlockwiseFederatedTrainer,
+    FederatedConfig,
+)
+
+K = 4
+
+
+class TinyNet(BlockModule):
+    """2-block toy CNN (same shape as tests/test_golden_trajectories.py)."""
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = max_pool_2x2(elu(nn.Conv(4, (5, 5), strides=(2, 2),
+                                     name="conv1")(x)))
+        return nn.Dense(10, name="fc1")(flatten(x))
+
+    def param_order(self):
+        return pairs("conv1", "fc1")
+
+    def train_order_block_ids(self):
+        return [[0, 1], [2, 3]]
+
+    def linear_layer_ids(self):
+        return [1]
+
+
+@pytest.fixture(scope="module")
+def data():
+    return FederatedCifar10(K=K, batch=16, limit_per_client=32,
+                            limit_test=32)
+
+
+def small_cfg(**kw):
+    base = dict(K=K, Nloop=1, Nepoch=1, Nadmm=2, default_batch=16,
+                check_results=False, admm_rho0=0.1)
+    base.update(kw)
+    return FederatedConfig(**base)
+
+
+def _digest(history, state):
+    """repr-exact loss trajectory + final parameter bytes (NaN-safe)."""
+    hist = [repr((r.get("nloop"), r.get("block"), r.get("nadmm"),
+                  r.get("loss"))) for r in history]
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(
+            state._asdict() if hasattr(state, "_asdict") else state):
+        h.update(np.ascontiguousarray(jax.device_get(leaf)).tobytes())
+    return hist, h.hexdigest()
+
+
+def _run(data, *, on_round=None, checkpoint_path=None, resume=False,
+         **cfg_kw):
+    t = BlockwiseFederatedTrainer(TinyNet(), small_cfg(**cfg_kw), data,
+                                  AdmmConsensus())
+    t.L = 2
+    return t.run(log=lambda m: None, on_round=on_round,
+                 checkpoint_path=checkpoint_path, resume=resume)
+
+
+# ----------------------------------------------------------------------
+class TestSampler:
+    def test_pure_function_of_seed_and_coords(self):
+        a = sample_cohort(1000, 8, seed=3, nloop=1, ci=2, nadmm=5)
+        b = sample_cohort(1000, 8, seed=3, nloop=1, ci=2, nadmm=5)
+        np.testing.assert_array_equal(a, b)
+        # and actually varies with the coordinates (rotation happens)
+        draws = {tuple(sample_cohort(1000, 8, seed=3, nloop=0, ci=0,
+                                     nadmm=n).tolist()) for n in range(6)}
+        assert len(draws) > 1
+
+    def test_sorted_unique_in_range(self):
+        for method in SAMPLER_CHOICES:
+            ids = sample_cohort(64, 8, seed=0, nloop=0, ci=1, nadmm=2,
+                                method=method)
+            assert ids.dtype == np.int64
+            lst = ids.tolist()
+            assert lst == sorted(set(lst)), method
+            assert 0 <= lst[0] and lst[-1] < 64, method
+
+    def test_identity_fast_path(self):
+        np.testing.assert_array_equal(
+            sample_cohort(8, 8, seed=9, nloop=4, ci=1, nadmm=7),
+            np.arange(8))
+
+    def test_stratified_takes_one_per_stratum(self):
+        ids = sample_cohort(64, 4, seed=1, nloop=0, ci=0, nadmm=0,
+                            method="stratified")
+        for i, rid in enumerate(ids.tolist()):
+            assert 16 * i <= rid < 16 * (i + 1)
+
+    def test_weights_are_static_and_bounded(self):
+        w = client_weights(100, 5)
+        np.testing.assert_array_equal(w, client_weights(100, 5))
+        assert w.shape == (100,) and (w > 0.5).all() and (w < 1.5).all()
+
+    def test_slot_mask(self):
+        assert cohort_slot_mask(8, 1.0, seed=0, nloop=0, ci=0,
+                                nadmm=0) is None
+        m = cohort_slot_mask(8, 0.5, seed=0, nloop=0, ci=0, nadmm=1)
+        assert m.shape == (8,) and m.sum() == 4
+        np.testing.assert_array_equal(
+            m, cohort_slot_mask(8, 0.5, seed=0, nloop=0, ci=0, nadmm=1))
+        # never empties the cohort
+        assert cohort_slot_mask(8, 0.01, seed=0, nloop=0, ci=0,
+                                nadmm=2).sum() == 1
+
+    def test_mask_stream_is_independent_of_the_id_stream(self):
+        # shrinking the active fraction must NOT change WHO is sampled —
+        # the control plane's cohort rung only gates slot activity
+        ids = sample_cohort(64, 8, seed=2, nloop=0, ci=0, nadmm=3)
+        np.testing.assert_array_equal(
+            ids, sample_cohort(64, 8, seed=2, nloop=0, ci=0, nadmm=3))
+
+
+# ----------------------------------------------------------------------
+class TestRegistry:
+    def test_validates(self):
+        with pytest.raises(ValueError, match="population"):
+            ClientRegistry(4, 8, seed=0)
+        with pytest.raises(ValueError, match="cohort_sampling"):
+            ClientRegistry(16, 8, seed=0, sampling="bogus")
+        assert ClientRegistry(8, 8, seed=0).identity
+        assert not ClientRegistry(16, 8, seed=0).identity
+
+    def test_gather_scatter_roundtrip(self):
+        reg = ClientRegistry(32, 4, seed=0)
+        cohort, _ = reg.draw(0, 0, 0)
+        rows = reg.gather_ledgers(cohort, round_clock=0)
+        rows["quarantine"][:] = [3, 0, 2, 0]
+        rows["members"][:] = [True, False, True, True]
+        reg.scatter_ledgers(cohort, **rows)
+        again = reg.gather_ledgers(cohort, round_clock=0)
+        np.testing.assert_array_equal(again["quarantine"], [3, 0, 2, 0])
+        np.testing.assert_array_equal(again["members"],
+                                      [True, False, True, True])
+
+    def test_late_async_arrival_clamps_to_now(self):
+        reg = ClientRegistry(32, 4, seed=0)
+        cohort, _ = reg.draw(0, 0, 0)
+        reg.async_arrival[cohort] = [2, -1, 7, 2]
+        reg.async_birth[cohort] = [1, 0, 1, 1]
+        rows = reg.gather_ledgers(cohort, round_clock=5)
+        # missed deliveries (2 < 5) deliver now; future (7) and idle (-1)
+        # slots are untouched, and staleness still measures from birth
+        np.testing.assert_array_equal(rows["arrival"], [5, -1, 7, 5])
+        np.testing.assert_array_equal(rows["birth"], [1, 0, 1, 1])
+
+    def test_comp_rows_follow_clients_across_cohorts(self):
+        reg = ClientRegistry(32, 2, seed=0)
+        a = np.asarray([3, 7])
+        reg.stash_comp_rows(a, [np.asarray([[1.0], [2.0]])], [True])
+        fresh = [np.zeros((2, 1))]
+        out = reg.load_comp_rows(np.asarray([7, 9]), fresh, [True])
+        np.testing.assert_array_equal(out[0], [[2.0], [0.0]])
+        assert fresh[0].sum() == 0          # fresh leaves not mutated
+        reg.reset_block()
+        assert reg.comp_rows == 0
+
+    def test_meta_restore_roundtrip(self):
+        reg = ClientRegistry(32, 4, seed=0)
+        cohort, _ = reg.draw(0, 0, 1)
+        reg.quarantine[5] = 9
+        reg.members[6] = False
+        reg.stash_comp_rows(cohort, [np.ones((4, 3))], [True])
+        meta = reg.meta(cohort)
+        reg2 = ClientRegistry(32, 4, seed=0)
+        back = reg2.restore(meta)
+        np.testing.assert_array_equal(back, cohort)
+        assert reg2.quarantine[5] == 9 and not reg2.members[6]
+        assert reg2.comp_rows == 4
+        with pytest.raises(ValueError, match="population"):
+            ClientRegistry(64, 4, seed=0).restore(meta)
+        # population-off meta: registry starts clean
+        assert ClientRegistry(32, 4, seed=0).restore({}) is None
+
+
+# ----------------------------------------------------------------------
+@pytest.mark.slow          # four tiny-but-real training runs (~90 s CPU)
+class TestEngineBitwise:
+    def test_full_participation_is_the_existing_engine(self, data):
+        """population == K (every client sampled every round) must be
+        bitwise the population-off engine: history AND parameter bytes."""
+        state0, hist0 = _run(data, population=0)
+        state1, hist1 = _run(data, population=K)
+        assert _digest(hist0, state0) == _digest(hist1, state1)
+
+    def test_kill_resume_bitwise_with_population(self, data, tmp_path):
+        """Kill mid-block, resume: the registry (ledgers + EF rows)
+        stitches through the checkpoint and the combined trajectory is
+        bitwise the uninterrupted one."""
+        kw = dict(population=64, seed=3, compress="topk",
+                  error_feedback=True)
+        state_u, hist_u = _run(data, **kw)
+
+        class Killed(Exception):
+            pass
+
+        def bomb(state, rec):
+            if rec["nadmm"] == 1 and rec["block"] == 0:
+                raise Killed
+
+        ck = str(tmp_path / "ck")
+        with pytest.raises(Killed):
+            _run(data, checkpoint_path=ck, on_round=bomb, **kw)
+        state_r, hist_r = _run(data, checkpoint_path=ck, resume=True, **kw)
+        assert _digest(hist_u, state_u) == _digest(hist_r, state_r)
+
+    def test_cohort_draw_survives_mesh_reshape(self, data, tmp_path):
+        """The SAME registry ids are drawn on a 2-device and a 4-device
+        mesh: the sampler sees (seed, round coords), never the mesh."""
+        seqs = []
+        for nd, sub in ((2, "d2"), (4, "d4")):
+            obs = str(tmp_path / sub)
+            _run(data, population=64, seed=3, num_devices=nd,
+                 obs_dir=obs, obs_sinks="jsonl")
+            ids = []
+            for f in sorted(os.listdir(obs)):
+                if not f.endswith(".jsonl"):
+                    continue
+                for line in open(os.path.join(obs, f)):
+                    r = json.loads(line)
+                    if isinstance(r.get("registry_ids"), list):
+                        ids.append([int(v) for v in r["registry_ids"]])
+            seqs.append(ids)
+        assert seqs[0] and seqs[0] == seqs[1]
+
+    def test_recorded_cohorts_replay_from_the_header(self, data, tmp_path):
+        """control.replay re-derives every recorded cohort from the
+        header config + round coordinates — and catches tampering."""
+        from federated_pytorch_test_tpu.control import replay
+
+        obs = str(tmp_path / "obs")
+        _run(data, population=64, seed=3, obs_dir=obs, obs_sinks="jsonl")
+        recs = [json.loads(line)
+                for f in sorted(os.listdir(obs)) if f.endswith(".jsonl")
+                for line in open(os.path.join(obs, f))]
+        errors, stats = replay.replay(recs)
+        assert errors == []
+        assert stats["cohort_records"] > 0
+        bad = [dict(r) for r in recs]
+        for r in bad:
+            if isinstance(r.get("registry_ids"), list):
+                r["registry_ids"] = [(int(v) + 1) % 64
+                                     for v in r["registry_ids"]]
+                break
+        errors, _ = replay.replay(bad)
+        assert any("seeded draw" in e for e in errors)
+
+
+# ----------------------------------------------------------------------
+class TestControlCohortRung:
+    def test_shrink_cohort_before_shrink_batch(self):
+        p = ControlPolicy(default_batch=32, population=256)
+        fired = []
+        for i in range(0, 200, 8):
+            fired += p.observe(
+                {"event": "alert", "round_index": i,
+                 "rule": "throughput_collapse", "severity": "warn",
+                 "observed": 1.0, "threshold": 1.0, "streak": 1})
+        assert [d.to_value for d in fired
+                if d.intervention == "shrink_cohort"] == [0.5, 0.25]
+        assert p.cur_frac == 0.25
+        # cohort floor reached -> the batch rung takes over
+        assert [d.to_value for d in fired
+                if d.intervention == "shrink_batch"] == [16, 8]
+
+    def test_grow_cohort_on_sustained_health(self):
+        p = ControlPolicy(default_batch=32, population=256)
+        for i in range(0, 48, 8):
+            p.observe({"event": "alert", "round_index": i,
+                       "rule": "throughput_collapse", "severity": "warn",
+                       "observed": 1.0, "threshold": 1.0, "streak": 1})
+        assert p.cur_frac < 1.0
+        fired = []
+        for i in range(300, 360):
+            fired += p.observe(
+                {"event": "round", "round_index": i, "round_seconds": 1.0,
+                 "comm_seconds": 0.1, "loss": 1.0, "images": 64})
+        grows = [d.to_value for d in fired
+                 if d.intervention == "grow_cohort"]
+        assert grows and grows[-1] == 1.0 and p.cur_frac == 1.0
+
+    def test_population_off_never_touches_the_cohort(self):
+        p = ControlPolicy(default_batch=32)
+        fired = []
+        for i in range(0, 200, 8):
+            fired += p.observe(
+                {"event": "alert", "round_index": i,
+                 "rule": "throughput_collapse", "severity": "warn",
+                 "observed": 1.0, "threshold": 1.0, "streak": 1})
+        assert not [d for d in fired if d.param == "cohort_frac"]
+        assert [d.to_value for d in fired
+                if d.intervention == "shrink_batch"] == [16, 8]
+
+    def test_supervisor_ladder_degrades_cohort_frac(self):
+        cfg = small_cfg(population=256)
+        assert _stage_reduced_cohort(cfg) == {"cohort_frac": 0.5}
+        cfg = small_cfg(population=256, cohort_frac=0.5)
+        assert _stage_reduced_cohort(cfg) == {"cohort_frac": 0.25}
+        cfg = small_cfg(population=256, cohort_frac=0.25)
+        assert _stage_reduced_cohort(cfg) == {}
+
+
+# ----------------------------------------------------------------------
+class TestSparseLedger:
+    def test_registry_ids_key_the_flight_recorder(self):
+        from federated_pytorch_test_tpu.obs.clients import ClientLedger
+
+        led = ClientLedger()
+        base = {"event": "client", "round_index": 0, "nloop": 0,
+                "block": 0, "nadmm": 0, "clients": 2}
+        led.observe({**base, "registry_ids": [3, 900],
+                     "update_norm": [1.0, 1.0], "loss_client": [1.0, 1.0]})
+        led.observe({**base, "round_index": 1, "nadmm": 1,
+                     "registry_ids": [3, 41],
+                     "update_norm": [1.0, 50.0],
+                     "loss_client": [1.0, 9.0]})
+        assert led.sparse and led.clients == 3
+        assert led.ids() == [3, 41, 900]
+        assert led.summary_fields()["top_offender"] == 41
+        assert led.ranking()[0]["client"] == 41
