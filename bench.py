@@ -1429,6 +1429,177 @@ def _population_bench() -> int:
                              "--threshold", "45"])
 
 
+_SOAK_BASELINE = "artifacts/SOAK_BASELINE.json"
+_SOAK_METRIC = "soak_availability_pct"
+#: the nightly campaign: 48 virtual hours of diurnal load with churn
+#: waves, straggler storms, correlated corruption bursts, and two
+#: deterministic preemptions (virtual hours 12 and 30) that force two
+#: supervised restarts with elastic mesh reshapes.  accel=600 turns the
+#: seeded restart backoffs into milliseconds of wall clock without
+#: touching any recorded value (PARITY.md v0.13).
+_SOAK_SPEC = ("hours=48,round_minutes=30,diurnal=0.6,drop=0.15,"
+              "straggle=0.1,mode=scale,scale=50,join=0.1,leave=0.1,"
+              "storm=0.2,storm_len=2,storm_straggle=0.6,burst=0.25,"
+              "burst_len=2,burst_corrupt=0.4,preempt_at=12+30,seed=11,"
+              "accel=600")
+
+
+def _soak_engine_run(tmp: str):
+    """Run the seeded 48-virtual-hour campaign unattended; returns the
+    stitched multi-segment JSONL path."""
+    import flax.linen as nn
+
+    from federated_pytorch_test_tpu.campaign.harness import run_soak
+    from federated_pytorch_test_tpu.data.cifar10 import FederatedCifar10
+    from federated_pytorch_test_tpu.models.base import (
+        BlockModule,
+        elu,
+        flatten,
+        max_pool_2x2,
+        pairs,
+    )
+    from federated_pytorch_test_tpu.train import (
+        AdmmConsensus,
+        BlockwiseFederatedTrainer,
+        FederatedConfig,
+    )
+
+    class SoakNet(BlockModule):
+        @nn.compact
+        def __call__(self, x, train=True):
+            x = max_pool_2x2(elu(nn.Conv(4, (5, 5), strides=(2, 2),
+                                         name="conv1")(x)))
+            return nn.Dense(10, name="fc1")(flatten(x))
+
+        def param_order(self):
+            return pairs("conv1", "fc1")
+
+        def train_order_block_ids(self):
+            return [[0, 1], [2, 3]]
+
+        def linear_layer_ids(self):
+            return [1]
+
+    K = 8
+    # Nloop * blocks * Nadmm = 8 * 2 * 6 = 96 rounds = 48 virtual hours
+    # at 30-minute rounds, covering the full campaign span
+    cfg = FederatedConfig(K=K, Nloop=8, Nepoch=1, Nadmm=6,
+                          default_batch=16, check_results=False,
+                          admm_rho0=0.1, seed=11,
+                          campaign_spec=_SOAK_SPEC, control="act",
+                          max_restarts=3, restart_backoff=1.0,
+                          elastic_resume=True,
+                          obs_dir=os.path.join(tmp, "obs"),
+                          obs_sinks="jsonl")
+    data = FederatedCifar10(K=K, batch=16, limit_per_client=16,
+                            limit_test=16)
+
+    def build(c, attempt):
+        t = BlockwiseFederatedTrainer(SoakNet(), c, data, AdmmConsensus())
+        t.obs_run_name = "soak"
+        return t
+
+    run_soak(build, cfg, os.path.join(tmp, "ck"),
+             run_kwargs={"log": lambda m: None}, log=lambda m: None)
+    return os.path.join(tmp, "obs", "soak.jsonl")
+
+
+def _soak() -> int:
+    """``bench.py --soak``: the nightly no-TPU availability gate for soak
+    campaigns (campaign/).  Runs the seeded accelerated 48-virtual-hour
+    campaign (diurnal load, churn waves, storms, corruption bursts, two
+    deterministic preemptions -> two supervised restarts with elastic
+    reshapes), verifies the stitched stream with ``control.replay``
+    (any divergence fails the gate), and emits a bench-shaped artifact
+    (``artifacts/soak.json``) whose headline is availability %% —
+    distinct rounds over distinct + lost (replayed + restarts) — diffed
+    against the committed ``artifacts/SOAK_BASELINE.json`` via
+    obs/compare.py (availability down or rounds-lost up is exit 1).
+    The campaign is a pure function of its seeds, so the gated numbers
+    are deterministic, not timings."""
+    # must land before this process's first jax import
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import tempfile
+
+    out = {
+        "metric": _SOAK_METRIC,
+        "unit": "percent (distinct rounds / (distinct + lost))",
+        "measured": True,
+        "baseline_ref": _SOAK_BASELINE,
+        "soak_spec": _SOAK_SPEC,
+    }
+    t0 = time.perf_counter()  # graftlint: disable=JG104
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            path = _soak_engine_run(tmp)
+            from federated_pytorch_test_tpu.control.replay import replay
+            from federated_pytorch_test_tpu.obs.report import (
+                read_records,
+                summarize,
+            )
+
+            records = read_records(path)
+            s = summarize(records)
+            errors, stats = replay(records)
+    except Exception as e:      # noqa: BLE001 — report, don't traceback
+        out["error"] = f"soak campaign run failed: {type(e).__name__}: {e}"
+    else:
+        out["value"] = s.get("availability_pct")
+        out["soak_rounds_lost"] = s.get("rounds_lost")
+        out["soak_rounds_distinct"] = s.get("rounds_distinct")
+        out["soak_segments"] = s.get("segments")
+        out["soak_restarts"] = s.get("restarts")
+        out["soak_reshapes"] = s.get("reshapes")
+        out["soak_campaign_records"] = s.get("campaign_records")
+        out["soak_virtual_hours"] = s.get("campaign_virtual_hours")
+        out["soak_replay_errors"] = len(errors)
+        out["soak_replay_records"] = stats
+        if errors:
+            out["error"] = ("soak stream failed replay verification: "
+                            + errors[0])
+        elif s.get("restarts", 0) < 2 or not s.get("reshapes"):
+            out["error"] = (
+                "soak campaign did not exercise the restart path "
+                f"(restarts={s.get('restarts')}, "
+                f"reshapes={s.get('reshapes')}); the schedule's "
+                "preempt_at events must force >= 2 supervised restarts "
+                "with >= 1 mesh reshape")
+    out["soak_wall_seconds"] = round(time.perf_counter() - t0, 2)  # graftlint: disable=JG104
+    out["captured_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    out["git"] = _git_describe()
+    art_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "artifacts")
+    path = os.path.join(art_dir, "soak.json")
+    try:
+        os.makedirs(art_dir, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+    except OSError as e:
+        print(f"bench: cannot write soak artifact: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(out))
+    if out.get("error"):
+        return 1
+    baseline = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            _SOAK_BASELINE)
+    if not os.path.exists(baseline):
+        print(f"bench: no committed {_SOAK_BASELINE}; soak gate skipped "
+              "(commit the emitted artifacts/soak.json there to arm it)",
+              file=sys.stderr)
+        return 0
+    from federated_pytorch_test_tpu.obs import compare as obs_compare
+
+    # the campaign is seed-deterministic; the band only absorbs
+    # rounding of the availability percentage
+    return obs_compare.main([path, "--baseline", baseline,
+                             "--threshold", "5"])
+
+
 if __name__ == "__main__":
     if "--measure" in sys.argv[1:]:
         sys.exit(_measure_child())
@@ -1436,4 +1607,6 @@ if __name__ == "__main__":
         sys.exit(_smoke())
     if "--population-bench" in sys.argv[1:]:
         sys.exit(_population_bench())
+    if "--soak" in sys.argv[1:]:
+        sys.exit(_soak())
     main()

@@ -1,0 +1,22 @@
+"""Soak campaigns: trace-driven heavy-traffic schedules (campaign/).
+
+The resilience stack (faults, churn, control plane, restart supervisor,
+elastic reshape, population cohorts) is exercised by the tests for
+seconds at a time; this package is the "operate unattended for weeks"
+story.  A declarative schedule spec (:mod:`.schedule`) compiles diurnal
+arrival curves, churn waves, straggler storms, correlated corruption
+bursts and deterministic preemption events into the existing seeded
+fault families; a deterministic virtual clock (:mod:`.clock`) scales a
+simulated week into CI minutes without touching any recorded value; and
+the soak harness (:mod:`.harness`) drives supervisor-managed
+multi-restart campaigns whose every segment lands in ONE obs stream
+that ``control.replay`` re-derives bit-exactly.
+"""
+
+from federated_pytorch_test_tpu.campaign.clock import VirtualClock
+from federated_pytorch_test_tpu.campaign.harness import run_soak
+from federated_pytorch_test_tpu.campaign.schedule import (
+    CampaignSchedule, CampaignWindow)
+
+__all__ = ["CampaignSchedule", "CampaignWindow", "VirtualClock",
+           "run_soak"]

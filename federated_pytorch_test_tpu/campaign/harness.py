@@ -1,0 +1,139 @@
+"""Soak harness: supervised multi-restart campaign runs on one stream.
+
+:func:`run_soak` is the campaign twin of
+:func:`~federated_pytorch_test_tpu.control.supervisor.supervise_classifier`:
+it compiles the config's ``campaign_spec``, builds the
+:class:`~federated_pytorch_test_tpu.campaign.clock.VirtualClock` from the
+resolved acceleration factor, and threads the clock's ``sleep`` through
+the supervisor so restart backoffs wait ``backoff / accel`` wall seconds
+while the RECORDED ``backoff_seconds`` stay the unscaled seeded values —
+``control.replay`` verifies the same numbers at any acceleration
+(PARITY.md v0.13).
+
+Every attempt's trainer is pinned to one ``obs_run_name`` so all
+segments append to a single campaign JSONL: run headers delimit
+segments, supervisor restart/reshape/ladder records land in the dying
+segment, and ``obs.report`` aggregates the whole file into availability
+% and rounds lost (see README "Soak campaigns").
+
+The harness also maps the spec's ``health_window_hours`` (virtual time)
+onto the engine's round-count ``health_window`` knob, so health
+escalation windows track the campaign's virtual clock rather than a
+round count tuned for short runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from federated_pytorch_test_tpu.campaign.clock import VirtualClock
+from federated_pytorch_test_tpu.campaign.schedule import CampaignSchedule
+
+__all__ = ["resolve_accel", "soak_config", "run_soak", "selftest"]
+
+
+def resolve_accel(cfg, sched: CampaignSchedule) -> float:
+    """Acceleration factor: CLI knob wins, then spec, then real time.
+
+    Acceleration is scheduling-inert by construction — it only divides
+    wall-clock waits, never the virtual times or probabilities recorded
+    in the stream — so any value replays identically.
+    """
+    accel = float(getattr(cfg, "campaign_accel", 0.0) or 0.0)
+    if accel <= 0:
+        accel = float(sched.accel or 0.0)
+    return accel if accel > 0 else 1.0
+
+
+def soak_config(cfg, sched: CampaignSchedule):
+    """Config with campaign-derived knobs applied (pure, returns a copy).
+
+    ``health_window_hours`` (virtual) becomes the engine's round-count
+    ``health_window``: ``max(2, round(H * 3600 / round_seconds))``.
+    Zero (the default) leaves the engine knob untouched.
+    """
+    if sched.health_window_hours > 0:
+        rounds = max(2, round(sched.health_window_hours * 3600.0
+                              / sched.round_seconds))
+        cfg = dataclasses.replace(cfg, health_window=rounds)
+    return cfg
+
+
+def run_soak(build_trainer, cfg, checkpoint_path: str, *,
+             state=None, resume: bool = False,
+             run_kwargs: Optional[Dict[str, Any]] = None,
+             retry_on: Tuple = (),
+             log: Callable[[str], None] = print,
+             engine: str = "classifier",
+             run_name: str = "soak"):
+    """Supervised campaign run; returns ``(result, clock)``.
+
+    ``build_trainer(cfg, attempt)`` is the same factory
+    :func:`supervise_classifier` takes; the harness pins each trainer's
+    ``obs_run_name`` to ``run_name`` (unless the factory already set
+    one) so every segment appends to the same campaign stream.  The
+    returned :class:`VirtualClock` reports how much virtual/wall time
+    the supervisor spent in backoff.
+    """
+    from federated_pytorch_test_tpu.control.supervisor import (
+        supervise_classifier)
+
+    sched = CampaignSchedule.parse(getattr(cfg, "campaign_spec", "none"))
+    if sched is None:
+        raise ValueError(
+            "run_soak requires a campaign: cfg.campaign_spec is "
+            f"{getattr(cfg, 'campaign_spec', 'none')!r} (use "
+            "supervise_classifier directly for plain supervised runs)")
+    clock = VirtualClock(accel=resolve_accel(cfg, sched))
+    cfg = soak_config(cfg, sched)
+
+    def build(c, attempt):
+        trainer = build_trainer(c, attempt)
+        if getattr(trainer, "obs_run_name", None) is None:
+            trainer.obs_run_name = run_name
+        return trainer
+
+    result = supervise_classifier(
+        build, cfg, checkpoint_path, state=state, resume=resume,
+        run_kwargs=run_kwargs, retry_on=retry_on, log=log,
+        sleep=clock.sleep, engine=engine)
+    return result, clock
+
+
+def selftest() -> str:
+    """Pure checks of accel resolution and health-window derivation."""
+    sched = CampaignSchedule.parse(
+        "hours=48,round_minutes=30,diurnal=0.5,accel=120,"
+        "health_window_hours=4")
+
+    class _Cfg:
+        campaign_accel = 0.0
+        health_window = 8
+
+    assert resolve_accel(_Cfg(), sched) == 120.0
+    cfg = _Cfg()
+    cfg.campaign_accel = 600.0
+    assert resolve_accel(cfg, sched) == 600.0       # CLI wins
+    plain = CampaignSchedule.parse("hours=2,round_minutes=30,diurnal=0.5")
+    assert resolve_accel(_Cfg(), plain) == 1.0      # real time default
+
+    # 4 virtual hours at 30-minute rounds -> 8-round health window
+    @dataclasses.dataclass
+    class _DCfg:
+        health_window: int = 2
+
+    assert soak_config(_DCfg(), sched).health_window == 8
+    assert soak_config(_DCfg(), plain).health_window == 2  # untouched
+    try:
+        run_soak(None, _DCfg(), "/tmp/nope")
+    except (ValueError, AttributeError):
+        pass
+    else:                                            # pragma: no cover
+        raise AssertionError("run_soak must reject campaign-off configs")
+    return ("campaign harness selftest OK: accel resolution and "
+            "health-window mapping are pure")
+
+
+if __name__ == "__main__":                           # pragma: no cover
+    print(selftest())

@@ -293,6 +293,73 @@ def check_cohort_records(segments: List[List[Dict[str, Any]]],
     return checked
 
 
+def check_campaign_records(segments: List[List[Dict[str, Any]]],
+                           errors: List[str]) -> int:
+    """Verify recorded campaign windows against the compiled schedule.
+
+    Soak campaigns (PARITY.md v0.13): every ``campaign`` record is a
+    pure function of (header ``campaign_spec``, the round indices this
+    segment completed) — the schedule compiler is stateless, so the
+    exact emission sequence (first round of the segment, every
+    virtual-hour boundary, every deterministic-preemption window)
+    re-derives from the header alone and must match the stream
+    field-by-field, bit-exactly.  A campaign record in a segment whose
+    header has no campaign is a forgery, exactly like cohorts.
+    """
+    from federated_pytorch_test_tpu.campaign.schedule import (
+        CAMPAIGN_FIELDS, CampaignSchedule)
+
+    checked = 0
+    for si, segment in enumerate(segments):
+        header = next((r for r in segment
+                       if r.get("event") == "run_header"), None)
+        config = (header or {}).get("config")
+        crecs = [r for r in segment if r.get("event") == "campaign"]
+        spec = (config or {}).get("campaign_spec") \
+            if isinstance(config, dict) else None
+        try:
+            sched = CampaignSchedule.parse(spec)
+        except ValueError as e:
+            errors.append(f"segment {si}: unparseable campaign_spec "
+                          f"{spec!r} in the header config: {e}")
+            continue
+        if sched is None:
+            if crecs:
+                errors.append(
+                    f"segment {si}: {len(crecs)} campaign record(s) but "
+                    "the header config has no campaign (or no config "
+                    "snapshot) — cannot have been produced by this "
+                    "configuration")
+            continue
+        rounds = [r["round_index"] for r in segment
+                  if r.get("event") == "round"
+                  and isinstance(r.get("round_index"), int)]
+        expected = sched.expected_emissions(rounds)
+        checked += len(crecs)
+        for i in range(max(len(expected), len(crecs))):
+            if i >= len(expected):
+                errors.append(
+                    f"segment {si} campaign record {i}: recorded but NOT "
+                    "derivable from the schedule (round_index="
+                    f"{crecs[i].get('round_index')!r})")
+                continue
+            ridx, fields = expected[i]
+            if i >= len(crecs):
+                errors.append(
+                    f"segment {si} campaign record {i}: derived from the "
+                    f"schedule (round {ridx}) but missing from the stream")
+                continue
+            got = {k: crecs[i].get(k) for k in CAMPAIGN_FIELDS}
+            if got != fields:
+                diff = ", ".join(
+                    f"{k}: recorded {got[k]!r} != derived {fields[k]!r}"
+                    for k in CAMPAIGN_FIELDS if got[k] != fields[k])
+                errors.append(
+                    f"segment {si} campaign record {i} (round {ridx}) "
+                    f"diverges: {diff}")
+    return checked
+
+
 def replay(records: List[Dict[str, Any]]) -> Tuple[List[str], Dict[str, int]]:
     """Full replay check; returns (errors, stats)."""
     errors: List[str] = []
@@ -301,10 +368,12 @@ def replay(records: List[Dict[str, Any]]) -> Tuple[List[str], Dict[str, int]]:
     n_sup = check_supervisor_records(records, errors)
     n_reshape = check_reshape_records(segments, errors)
     n_cohort = check_cohort_records(segments, errors)
+    n_campaign = check_campaign_records(segments, errors)
     return errors, {"segments": len(segments), "policy_records": n_policy,
                     "supervisor_records": n_sup,
                     "reshape_records": n_reshape,
-                    "cohort_records": n_cohort}
+                    "cohort_records": n_cohort,
+                    "campaign_records": n_campaign}
 
 
 def selftest() -> str:
@@ -451,6 +520,40 @@ def selftest() -> str:
         # registry_ids on a population-off stream is itself a divergence
         errors12, _ = replay(base + clients)
         assert errors12 and "population off" in errors12[0], errors12
+
+        # campaign windows: records re-derive from the header's
+        # campaign_spec + completed round indices; tampering a window
+        # field, dropping an emission, or forging a record on a
+        # campaign-off stream all diverge
+        from federated_pytorch_test_tpu.campaign.schedule import (
+            CampaignSchedule)
+        spec = "hours=3,round_minutes=30,diurnal=0.5,drop=0.2,seed=9"
+        sched = CampaignSchedule.parse(spec)
+        d6 = os.path.join(d, "campaign")
+        os.makedirs(d6, exist_ok=True)
+        camp_base = read_records(
+            synth(d6, [0.1] * sched.total_rounds, name="campaign"))
+        camped = [dict(r, config=dict(config, campaign_spec=spec))
+                  if r.get("event") == "run_header" else r
+                  for r in camp_base]
+        camp_recs = [dict({"event": "campaign",
+                           "schema": SCHEMA_VERSION, "run_id": "x"},
+                          **fields)
+                     for _, fields in sched.expected_emissions(
+                         range(sched.total_rounds))]
+        errors13, stats13 = replay(camped + camp_recs)
+        assert not errors13, errors13
+        assert stats13["campaign_records"] == len(camp_recs) >= 3, stats13
+        bad_camp = [dict(c) for c in camp_recs]
+        bad_camp[1]["drop_p"] = round(bad_camp[1]["drop_p"] + 0.01, 6)
+        errors14, _ = replay(camped + bad_camp)
+        assert errors14 and "diverges" in errors14[0], errors14
+        errors15, _ = replay(camped + camp_recs[:-1])
+        assert errors15 and "missing from the stream" in errors15[0], \
+            errors15
+        # campaign record on a campaign-off stream is a forgery
+        errors16, _ = replay(camp_base + camp_recs[:1])
+        assert errors16 and "no campaign" in errors16[0], errors16
         json.dumps(stats)  # stats stay JSON-representable
     return "control replay selftest: OK (decisions reproduce; tampering detected)"
 
@@ -486,8 +589,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 1
     print(f"replay OK: {stats['policy_records']} policy decision(s), "
           f"{stats['supervisor_records']} supervisor record(s), "
-          f"{stats['reshape_records']} reshape record(s) and "
-          f"{stats['cohort_records']} cohort record(s) reproduce "
+          f"{stats['reshape_records']} reshape record(s), "
+          f"{stats['cohort_records']} cohort record(s) and "
+          f"{stats['campaign_records']} campaign record(s) reproduce "
           f"across {stats['segments']} segment(s)")
     return 0
 

@@ -59,6 +59,20 @@ def build_parser(defaults: FederatedConfig, prog: str) -> argparse.ArgumentParse
                      "(train/faults.py; delay= drives --async-rounds "
                      "arrival times; join=/leave= drive the membership "
                      "ledger, preempt= simulates mid-run preemption)")
+        elif f.name == "campaign_spec":
+            p.add_argument(
+                arg, type=str, default=default, metavar="SPEC",
+                help="soak-campaign schedule (campaign/schedule.py): "
+                     "'none' or hours=H,round_minutes=M,diurnal=A,"
+                     "drop=P,straggle=P,corrupt=P,mode=...,join=P,"
+                     "leave=P,storm=P,storm_len=N,storm_straggle=P,"
+                     "burst=P,burst_len=N,burst_corrupt=P,"
+                     "preempt_at=H1+H2,seed=N,accel=X,"
+                     "health_window_hours=H — compiles diurnal load, "
+                     "churn waves, straggler storms, corruption bursts "
+                     "and deterministic preemptions onto the seeded "
+                     "fault families; mutually exclusive with "
+                     "--fault-spec (README 'Soak campaigns')")
         elif f.name == "model":
             p.add_argument(arg, choices=MODEL_CHOICES, default=default)
         elif f.name == "health_action":
@@ -286,15 +300,16 @@ def run_classifier_driver(prog: str, defaults: FederatedConfig,
         state, history = trainer.run_independent(state)
     else:
         supervised = cfg.max_restarts > 0
-        # supervision is resume-from-checkpoint: a restart budget forces
-        # the mid-run checkpoint on even without --midrun-checkpoint
+        campaign = getattr(cfg, "campaign_spec", "none") not in (
+            "none", "", None)
+        # supervision is resume-from-checkpoint: a restart budget (or a
+        # campaign, whose deterministic preemptions need a resume point)
+        # forces the mid-run checkpoint on even without
+        # --midrun-checkpoint
         ck = (checkpoint_path(cfg, prog + "_midrun")
-              if (cfg.midrun_checkpoint or supervised) else None)
-        if supervised:
-            from federated_pytorch_test_tpu.control.supervisor import (
-                supervise_classifier,
-            )
-
+              if (cfg.midrun_checkpoint or supervised or campaign)
+              else None)
+        if supervised or campaign:
             def build_trainer(c, attempt):
                 nonlocal trainer
                 if attempt > 1:
@@ -306,9 +321,23 @@ def run_classifier_driver(prog: str, defaults: FederatedConfig,
                     trainer.obs_run_name = prog
                 return trainer
 
-            state, history = supervise_classifier(
-                build_trainer, cfg, ck, state=state,
-                resume=cfg.load_model)
+            if campaign:
+                from federated_pytorch_test_tpu.campaign.harness import (
+                    run_soak,
+                )
+
+                (state, history), clock = run_soak(
+                    build_trainer, cfg, ck, state=state,
+                    resume=cfg.load_model, run_name=prog)
+                print(f"soak campaign done: {clock!r}")
+            else:
+                from federated_pytorch_test_tpu.control.supervisor import (
+                    supervise_classifier,
+                )
+
+                state, history = supervise_classifier(
+                    build_trainer, cfg, ck, state=state,
+                    resume=cfg.load_model)
         else:
             state, history = trainer.run(
                 state, checkpoint_path=ck,

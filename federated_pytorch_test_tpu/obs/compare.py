@@ -57,6 +57,11 @@ _DIRECTION = {
     "peak_device_bytes": -1,
     "utilization": +1,
     "cache_hit_rate": +1,
+    # soak campaigns (schema v12; bench.py --soak): the availability
+    # gate — losing availability or losing more rounds to restarts than
+    # the committed SOAK_BASELINE fails CI like a throughput regression
+    "availability_pct": +1,
+    "rounds_lost": -1,
 }
 
 
@@ -71,6 +76,12 @@ def _direction(name: str) -> int:
         return -1
     if name.endswith("_savings_ratio"):
         return +1
+    # soak gate fields on bench --soak artifacts (soak_availability_pct
+    # headline + soak_rounds_lost section metric)
+    if name.endswith("_availability_pct"):
+        return +1
+    if name.endswith("_rounds_lost"):
+        return -1
     return 0        # unknown: report the delta, never a verdict
 
 
@@ -168,6 +179,23 @@ def load_source(path: str) -> Dict[str, Any]:
                 f"(score {s.get('top_offender_score', 0.0):.3f}) over "
                 f"{s.get('client_records')} client record(s) — compare "
                 "across runs for offender stability")
+        # soak availability (schema v12): the two gated numbers of the
+        # availability contract plus info-direction campaign context, so
+        # a soak stream can be gated directly against a baseline stream
+        for k in ("availability_pct", "rounds_lost"):
+            v = _num(s.get(k))
+            if v is not None:
+                src["metrics"][k] = v
+        for k in ("segments", "campaign_records",
+                  "campaign_virtual_hours"):
+            v = _num(s.get(k))
+            if v is not None:
+                src["metrics"][k] = v
+        if s.get("campaign_records"):
+            src["notes"].append(
+                f"soak campaign stream: {s.get('segments')} segment(s), "
+                f"{s.get('campaign_virtual_hours')} virtual h, "
+                f"availability {s.get('availability_pct')}%")
         # device-cost metrics (schema v6): present only when the run's
         # ledger emitted them, so pre-v6 streams compare unchanged
         for k, val in profile_metrics(records).items():
@@ -215,11 +243,14 @@ def load_source(path: str) -> Dict[str, Any]:
                 # population_* covers bench.py --population-bench: the
                 # *_throughput and *_savings_ratio fields gate by suffix
                 # rule, the K/cohort/wall fields report as info
+                # soak_* covers bench.py --soak: availability/rounds-lost
+                # gate by the direction rules, the rest report as info
                 if (k.endswith("_ips_chip") or k == "mfu"
                         or k.endswith("_wire_bytes")
                         or k.endswith("_savings_ratio")
                         or k.startswith("smoke_")
-                        or k.startswith("population_")):
+                        or k.startswith("population_")
+                        or k.startswith("soak_")):
                     v = _num(val)
                     if v is not None:
                         src["metrics"][k] = v
@@ -426,6 +457,27 @@ def selftest() -> None:
             pass
         else:
             raise AssertionError("empty glob must raise (vacuous gate)")
+        # soak availability gate: losing availability or rounds REGRESSES
+        # (direction rules availability_pct/+1, *_rounds_lost/-1)
+        soak = {"metric": "soak_availability_pct", "value": 95.0,
+                "unit": "percent", "measured": True,
+                "soak_rounds_lost": 3.0}
+        sbase = os.path.join(d, "soak_base.json")
+        with open(sbase, "w") as f:
+            json.dump(soak, f)
+        ssame = os.path.join(d, "soak_same.json")
+        with open(ssame, "w") as f:
+            json.dump(dict(soak, baseline_ref=sbase), f)
+        assert run([ssame]) == 0, "soak self-vs-self must exit 0"
+        sbad = os.path.join(d, "soak_bad.json")
+        with open(sbad, "w") as f:
+            json.dump(dict(soak, value=70.0, soak_rounds_lost=9.0), f)
+        assert run([sbad, "--baseline", sbase]) == 1, \
+            "availability drop must exit 1"
+        assert _direction("availability_pct") == +1
+        assert _direction("rounds_lost") == -1
+        assert _direction("soak_availability_pct") == +1
+        assert _direction("soak_rounds_lost") == -1
 
 
 if __name__ == "__main__":

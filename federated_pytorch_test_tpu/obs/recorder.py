@@ -384,6 +384,26 @@ class RunRecorder:
             return None
         return self._emit(rec)
 
+    def campaign_event(self, fields: Dict[str, Any]) -> Optional[dict]:
+        """Emit one ``campaign`` record (schema v12; campaign/).
+
+        ``fields`` is a :meth:`~..campaign.schedule.CampaignSchedule.
+        record_fields` body: the hour-quantized schedule window the
+        engine applied from this round on.  Emitted right after the
+        round record of the window's first round, so file order equals
+        replay order.  Deliberately NO ``time_unix`` and NOT fed to the
+        controller: the window is a pure function of (campaign seed,
+        round_index) that ``control.replay`` re-derives from the header
+        config alone, and the live policy engine must see exactly the
+        record sequence replay feeds it (round/alert/client).
+        """
+        if not self.enabled:
+            return None
+        rec = {"event": "campaign", "schema": SCHEMA_VERSION,
+               "run_id": self.run_id}
+        rec.update(json_safe(fields))
+        return self._emit(rec)
+
     def compile_event(self, fields: Dict[str, Any], *,
                       parent_span: Optional[str] = None) -> Optional[dict]:
         """Emit one ``compile`` record (schema v6; obs/costs.py).

@@ -163,6 +163,82 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                           if c.get("intervention") == "restart")
     out["reshapes"] = sum(1 for c in controls
                           if c.get("intervention") == "reshape")
+    # soak campaigns (schema v12): restart-segment structure, the
+    # availability gate's two numbers (bench --soak / obs.compare
+    # direction rules), the campaign window rollup, the intervention
+    # timeline, and cohort health drift.  A round index appearing in
+    # two segments means the later segment REPLAYED it after a restart
+    # (work done twice), so rounds lost = replayed indices + one round
+    # of lost progress per restart; availability is the distinct-round
+    # fraction of that total.
+    seg_rounds: List[List[int]] = []
+    for r in records:
+        if r.get("event") == "run_header":
+            seg_rounds.append([])
+        elif (r.get("event") == "round"
+              and isinstance(r.get("round_index"), int)):
+            if not seg_rounds:
+                seg_rounds.append([])
+            seg_rounds[-1].append(r["round_index"])
+    out["segments"] = len(seg_rounds)
+    out["segment_round_ranges"] = [
+        [s[0], s[-1]] if s else None for s in seg_rounds]
+    distinct = len(set(idx))
+    out["rounds_distinct"] = distinct
+    out["rounds_replayed"] = len(idx) - distinct
+    out["rounds_lost"] = out["rounds_replayed"] + out["restarts"]
+    out["availability_pct"] = (
+        round(100.0 * distinct / (distinct + out["rounds_lost"]), 2)
+        if distinct else None)
+    camps = [r for r in records if r.get("event") == "campaign"]
+    out["campaign_records"] = len(camps)
+    out["campaign_virtual_hours"] = None
+    if camps:
+        slope = [r["virtual_seconds"] / r["round_index"] for r in camps
+                 if isinstance(r.get("round_index"), int)
+                 and r["round_index"] > 0
+                 and isinstance(r.get("virtual_seconds"), (int, float))]
+        vs = [r["virtual_seconds"] for r in camps
+              if isinstance(r.get("virtual_seconds"), (int, float))]
+        if slope and idx:
+            # virtual seconds per round is linear in the round index, so
+            # the campaign's span covers one window past the last round
+            out["campaign_virtual_hours"] = round(
+                (max(idx) + 1) * slope[-1] / 3600.0, 2)
+        elif vs:
+            out["campaign_virtual_hours"] = round(max(vs) / 3600.0, 2)
+        out["campaign_phases"] = sorted(
+            {str(r.get("phase")) for r in camps if r.get("phase")})
+        out["campaign_storm_windows"] = sum(
+            1 for r in camps if r.get("storm"))
+        out["campaign_burst_windows"] = sum(
+            1 for r in camps if r.get("burst"))
+        out["campaign_preempts"] = sum(
+            1 for r in camps if r.get("preempt_now"))
+    out["intervention_timeline"] = [
+        {"round_index": c.get("round_index"), "source": c.get("source"),
+         "intervention": c.get("intervention"), "param": c.get("param"),
+         "from_value": c.get("from_value"), "to_value": c.get("to_value")}
+        for c in controls]
+    # cohort health drift: mean finite per-client update norm, late half
+    # of the stream vs early half (None without ≥2 client records)
+    cnorms = []
+    for r in records:
+        if r.get("event") != "client":
+            continue
+        v = r.get("update_norm")
+        if isinstance(v, list):
+            fin = [x for x in v if isinstance(x, (int, float))
+                   and x == x and abs(x) != float("inf")]
+            if fin:
+                cnorms.append(sum(fin) / len(fin))
+    out["client_norm_drift_frac"] = None
+    if len(cnorms) >= 2:
+        half = len(cnorms) // 2
+        early = sum(cnorms[:half]) / half
+        late = sum(cnorms[half:]) / (len(cnorms) - half)
+        if early > 0:
+            out["client_norm_drift_frac"] = round(late / early - 1.0, 4)
     # device-cost ledger (schema v6): compile totals recomputed from the
     # round records; the memory watermark is the max across the rounds'
     # instantaneous stats (matches the recorder's summary field)
@@ -276,6 +352,44 @@ def format_report(s: Dict[str, Any]) -> str:
         row("control plane",
             f"{s['controls']} record(s), {s.get('restarts', 0)} restart(s)"
             f": {', '.join(s.get('control_interventions') or [])}")
+    if s.get("segments", 0) > 1 or s.get("rounds_lost"):
+        ranges = ", ".join(
+            "-" if rr is None else f"{rr[0]}..{rr[1]}"
+            for rr in s.get("segment_round_ranges") or [])
+        row("segments", f"{s.get('segments')} restart segment(s): "
+            f"rounds {ranges}")
+        if s.get("availability_pct") is not None:
+            row("availability",
+                f"{s['availability_pct']:.2f} %  "
+                f"({s.get('rounds_distinct')} distinct round(s); "
+                f"{s.get('rounds_lost')} lost = "
+                f"{s.get('rounds_replayed')} replayed + "
+                f"{s.get('restarts', 0)} restart(s))")
+    if s.get("campaign_records"):
+        msg = f"{s['campaign_records']} window record(s)"
+        if s.get("campaign_virtual_hours") is not None:
+            msg += f", {s['campaign_virtual_hours']:.1f} virtual h"
+        msg += (f", storms={s.get('campaign_storm_windows', 0)} "
+                f"bursts={s.get('campaign_burst_windows', 0)} "
+                f"preempts={s.get('campaign_preempts', 0)}; phases: "
+                + ", ".join(s.get("campaign_phases") or []))
+        row("campaign", msg)
+    if s.get("client_norm_drift_frac") is not None:
+        row("cohort drift",
+            f"{100.0 * s['client_norm_drift_frac']:+.1f} % mean "
+            "update-norm, late vs early half")
+    timeline = s.get("intervention_timeline") or []
+    if timeline:
+        row("interventions", f"{len(timeline)} event(s):")
+        for ev in timeline[:12]:
+            msg = (f"round {ev.get('round_index')}: "
+                   f"{ev.get('source')}/{ev.get('intervention')}")
+            if ev.get("param") is not None:
+                msg += (f" {ev['param']}: {ev.get('from_value')!r}"
+                        f" -> {ev.get('to_value')!r}")
+            lines.append(f"    {msg}")
+        if len(timeline) > 12:
+            lines.append(f"    ... {len(timeline) - 12} more")
     if s.get("compile_events") or s.get("compile_seconds_total"):
         msg = f"{s.get('compile_events', 0)} event(s)"
         if s.get("compile_seconds_total") is not None:
@@ -352,6 +466,55 @@ def selftest() -> str:
     assert record_ips({"images": 256, "round_seconds": 0}) == float("inf")
     assert record_ips({"images": 0, "round_seconds": 0}) == 0.0
 
+    # soak aggregation: a synthetic two-segment campaign stream — the
+    # restart replays rounds 2..3, so 6 distinct rounds cost 8 round
+    # records + 1 restart -> availability 6/(6+3)
+    from federated_pytorch_test_tpu.campaign.schedule import (
+        CampaignSchedule)
+    sched = CampaignSchedule.parse(
+        "hours=3,round_minutes=30,diurnal=0.5,drop=0.2,seed=9")
+
+    def rr(i):
+        return {"event": "round", "round_index": i, "round_seconds": 1.0,
+                "images": 64, "loss": 1.0}
+
+    camp = [dict({"event": "campaign", "schema": 12, "run_id": "x"},
+                 **fields)
+            for _, fields in sched.expected_emissions(range(6))]
+    soak = ([{"event": "run_header", "run_id": "x", "schema": 12}]
+            + [rr(i) for i in range(4)] + camp[:2]
+            + [{"event": "control", "run_id": "x", "schema": 12,
+                "round_index": 3, "source": "supervisor", "mode": "act",
+                "intervention": "restart", "param": "run", "attempt": 1,
+                "backoff_seconds": 1.0, "reason": "selftest"}]
+            + [{"event": "run_header", "run_id": "x", "schema": 12}]
+            + [rr(i) for i in range(2, 6)] + camp[2:]
+            + [{"event": "client", "run_id": "x", "schema": 12,
+                "round_index": i, "clients": 2,
+                "update_norm": [1.0 + 0.5 * (i >= 3)] * 2}
+               for i in range(6)])
+    ss = summarize(soak)
+    assert ss["segments"] == 2, ss
+    assert ss["segment_round_ranges"] == [[0, 3], [2, 5]], ss
+    assert ss["rounds_distinct"] == 6, ss
+    assert ss["rounds_replayed"] == 2 and ss["restarts"] == 1, ss
+    assert ss["rounds_lost"] == 3, ss
+    assert ss["availability_pct"] == round(100.0 * 6 / 9, 2), ss
+    assert ss["campaign_records"] == len(camp) == 3, ss
+    assert ss["campaign_virtual_hours"] == 3.0, ss
+    assert len(ss["intervention_timeline"]) == 1, ss
+    assert ss["client_norm_drift_frac"] == 0.5, ss
+    soak_table = format_report(ss)
+    assert "availability" in soak_table, soak_table
+    assert "2 restart segment(s)" in soak_table, soak_table
+    assert "campaign" in soak_table, soak_table
+    assert "supervisor/restart" in soak_table, soak_table
+
+    from federated_pytorch_test_tpu.campaign import clock as campaign_clock
+    from federated_pytorch_test_tpu.campaign import (
+        harness as campaign_harness)
+    from federated_pytorch_test_tpu.campaign import (
+        schedule as campaign_schedule)
     from federated_pytorch_test_tpu.control import replay as control_replay
     from federated_pytorch_test_tpu.obs import (
         clients, compare, health, profile, trace,
@@ -363,6 +526,9 @@ def selftest() -> str:
     profile.selftest()
     control_replay.selftest()
     clients.selftest()
+    campaign_schedule.selftest()
+    campaign_clock.selftest()
+    campaign_harness.selftest()
     return (table
             + "\nobs trace selftest: OK (Chrome trace valid)"
             + "\nobs health selftest: OK (NaN streak alerted)"
@@ -370,6 +536,8 @@ def selftest() -> str:
             + "\nobs profile selftest: OK (cost attribution reconstructs)"
             + "\ncontrol replay selftest: OK (decisions reproduce)"
             + "\nobs clients selftest: OK (anomaly ranking replayable)"
+            + "\ncampaign selftests: OK (schedule pure; clock scales "
+            "wall time only; harness maps knobs)"
             + "\nobs report selftest: OK")
 
 

@@ -1,6 +1,6 @@
 """Versioned record schema for run telemetry.
 
-One run = one JSONL stream of eight event kinds:
+One run = one JSONL stream of nine event kinds:
 
 - ``run_header``  — emitted once when a run (or resumed segment) opens:
   config snapshot, mesh shape, jax/backend versions, git rev.
@@ -33,6 +33,13 @@ One run = one JSONL stream of eight event kinds:
   fault tags, async staleness/admission, and membership — the round
   record's counters, un-aggregated.  Emitted right AFTER the round
   record it describes, so file order is the replay order.
+- ``campaign``    — one per schedule-window transition (schema v12;
+  ``campaign/``): the hour-quantized slice of the trace-driven soak
+  schedule the engine applied from this round on — diurnal arrival
+  fraction, derived fault/churn probabilities, storm/burst flags,
+  deterministic preemption marker.  Pure function of (campaign seed,
+  round_index): ``control.replay`` re-derives the whole campaign from
+  the run header's ``campaign_spec``.
 
 The schema unifies what ``engine.py``, ``cpc_engine.py`` and
 ``vae_engine.py`` used to build as ad-hoc dicts; every record carries
@@ -140,11 +147,26 @@ from typing import Any, Dict
 # aggregates byte-exactly over the full population even though each
 # record only carries the sampled cohort.  Absent on population-off
 # streams, which therefore stay byte-identical to v10.
-# v1..v10 records remain valid: validate_record accepts ver <= SCHEMA_VERSION.
-SCHEMA_VERSION = 11
+# v12 (additive): soak campaigns (campaign/) — a new `campaign` record
+# kind, emitted right after the round record whenever the trace-driven
+# schedule's hour-quantized window transitions (first round of a
+# segment, every virtual-hour boundary, and any post-resume re-run of a
+# preempted round).  Carries the window the engine actually applied:
+# `virtual_seconds` (round_index * round_minutes * 60 — virtual time is
+# a pure function of the round index), `arrival_frac` (the diurnal
+# curve), the derived per-family probabilities `drop_p`/`straggle_p`/
+# `corrupt_p`/`join_p`/`leave_p`, the correlated-event flags `storm`/
+# `burst` (seeded tags 73/79), `preempt_now`, and the human-facing
+# `phase` label.  Deliberately NO time_unix: every field is a pure
+# function of (campaign seed, round_index), so control.replay
+# re-derives the whole campaign schedule bit-exactly from the header
+# config's campaign_spec alone.  Campaign-off streams carry no
+# `campaign` records and stay byte-identical to v11.
+# v1..v11 records remain valid: validate_record accepts ver <= SCHEMA_VERSION.
+SCHEMA_VERSION = 12
 
 EVENTS = ("run_header", "round", "summary", "span", "alert", "compile",
-          "control", "client")
+          "control", "client", "campaign")
 
 
 class SchemaError(ValueError):
@@ -186,7 +208,7 @@ FIELDS: Dict[str, Any] = {
     # round coordinates (spans and alerts are keyed to the same index the
     # XProf round_trace annotations use, so all three timelines correlate)
     "round_index":  (("round", "span", "alert", "compile", "control",
-                      "client"), _INT),
+                      "client", "campaign"), _INT),
     "nloop":        (("round",), _INT),
     "block":        (("round",), _INT),
     "nadmm":        (("round",), _INT),
@@ -316,6 +338,22 @@ FIELDS: Dict[str, Any] = {
     "members":      (("client",), _LIST),     # churn roster after tick
     "registry_ids": (("client",), _LIST),     # population: slot -> rid (v11)
     "payload_bytes": (("client",), _INT),     # uplink bytes/participant
+    # soak-campaign schedule windows (schema v12; campaign/).  One per
+    # window TRANSITION, right after the round record it rides with; no
+    # time_unix — every field is a pure function of (campaign seed,
+    # round_index), re-derived bit-exactly by control.replay from the
+    # header config's campaign_spec.
+    "virtual_seconds": (("campaign",), _NUM),  # round_index * round secs
+    "arrival_frac": (("campaign",), _NUM),     # diurnal curve, [0, 1]
+    "drop_p":       (("campaign",), _NUM),     # derived family probs
+    "straggle_p":   (("campaign",), _NUM),
+    "corrupt_p":    (("campaign",), _NUM),
+    "join_p":       (("campaign",), _NUM),
+    "leave_p":      (("campaign",), _NUM),
+    "storm":        (("campaign",), _BOOL),    # seeded tag-73 event live
+    "burst":        (("campaign",), _BOOL),    # seeded tag-79 event live
+    "preempt_now":  (("campaign",), _BOOL),    # deterministic preempt_at
+    "phase":        (("campaign",), _STR),     # trough|shoulder|peak|...
     # summary totals / rates
     "status":       (("summary",), _STR),
     "rounds":       (("summary",), _INT),
@@ -360,6 +398,8 @@ REQUIRED = {
     "control": ("event", "schema", "run_id", "round_index", "source",
                 "intervention"),
     "client": ("event", "schema", "run_id", "round_index", "clients"),
+    "campaign": ("event", "schema", "run_id", "round_index",
+                 "virtual_seconds"),
 }
 
 

@@ -112,6 +112,25 @@ class FederatedConfig:
     #   delay=P,delay_max=N,join=P,leave=P,preempt=P
     fault_spec: str = "none"
 
+    # soak campaigns (campaign/): a trace-driven heavy-traffic schedule
+    # compiled per round into the seeded fault/churn families — diurnal
+    # arrival curves, churn waves, straggler storms, correlated
+    # corruption bursts, deterministic preemption events — recorded as
+    # additive `campaign` records (schema v12) that control.replay
+    # re-derives bit-exactly.  "none" = campaign off (the literal seed
+    # path, bitwise).  Mutually exclusive with fault_spec (the campaign
+    # OWNS the fault families' probabilities per round).  Grammar:
+    #   hours=H,round_minutes=M,diurnal=A,drop=P,straggle=P,corrupt=P,
+    #   mode=M,scale=X,join=P,leave=P,storm=P,storm_len=N,
+    #   storm_straggle=P,burst=P,burst_len=N,burst_corrupt=P,
+    #   preempt_at=h1+h2,seed=N,accel=X,health_window_hours=H
+    campaign_spec: str = "none"
+    # virtual-clock acceleration override (virtual seconds per wall
+    # second) for the soak harness; 0 = use the spec's accel= (else
+    # real time).  Scheduling-inert: scales only actual sleeps, never
+    # any recorded value (PARITY.md v0.13).
+    campaign_accel: float = 0.0
+
     # elastic federation (mesh-reshaping resume): allow a checkpoint
     # written on a D-device mesh to restore onto a D'-device mesh — the
     # [K, ...] client stack restages onto the surviving mesh (K % D' must

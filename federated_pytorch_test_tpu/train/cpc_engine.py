@@ -764,8 +764,12 @@ class CPCTrainer(RoundKernel):
                     "no valid mid-run checkpoint slot survives: "
                     + "; ".join(failures))
         # simulated preemption is one-shot per segment: a resumed segment
-        # replaying the drawn round must not re-fire it (RoundKernel)
+        # replaying the drawn round must not re-fire it (RoundKernel).
+        # The campaign floor is the deterministic preempt_at twin, and
+        # the transition-only `campaign` emission restarts per segment.
         self._preempt_armed = resume_at is None
+        self._campaign_floor = len(history) if resume_at is not None else -1
+        self._campaign_last_hour = None
 
         # size the producer by walking the ACTUAL remaining loop structure
         # (not total - len(history): a resume under a different
@@ -868,8 +872,10 @@ class CPCTrainer(RoundKernel):
         state, z, opt_state = box
         cfg = self.cfg
         t_round = time.perf_counter()
-        # simulated preemption fires BEFORE any work this round, at the
-        # same boundary the classifier engine uses
+        # campaign tick then simulated preemption BEFORE any work this
+        # round, at the same boundary the classifier engine uses
+        self._campaign_tick(len(history), nloop, flat_bi, nadmm,
+                            checkpoint_path)
         self._maybe_preempt(nloop, flat_bi, nadmm, len(history),
                             checkpoint_path)
         px, py, batch = (src.get() if src is not None

@@ -1606,6 +1606,12 @@ class BlockwiseFederatedTrainer(RoundKernel):
         # already lost once, and the supervisor's restart is the
         # surviving mesh carrying on
         self._preempt_armed = resume_at is None
+        # the campaign twin of that arming flag: deterministic
+        # preempt_at events only fire STRICTLY past the resumed
+        # segment's starting round, and the transition-only `campaign`
+        # record emission restarts with the segment
+        self._campaign_floor = len(history) if resume_at is not None else -1
+        self._campaign_last_hour = None
 
         if cfg.async_checkpoint and checkpoint_path is not None:
             # created AFTER the resume restore (nothing may be in flight
@@ -1688,6 +1694,12 @@ class BlockwiseFederatedTrainer(RoundKernel):
                     with round_trace(len(history),
                                      enabled=cfg.profile_dir is not None):
                         t_round = time.perf_counter()
+                        # the campaign tick FIRST: it derives this
+                        # round's fault spec (and may raise the
+                        # deterministic preempt_at event) before any
+                        # family draws from it
+                        self._campaign_tick(len(history), nloop, ci,
+                                            nadmm, checkpoint_path)
                         self._maybe_preempt(nloop, ci, nadmm,
                                             len(history), checkpoint_path)
                         active, comm_active, corrupt, comm_host, fcounts = \
@@ -1706,7 +1718,7 @@ class BlockwiseFederatedTrainer(RoundKernel):
                             rows = (self._cohort % cfg.K).astype(np.int64)
                             cnorm = stage_global(
                                 self._client_norm_host[rows], csh)
-                        if (self.faults.churn_enabled
+                        if (self._churn_live
                                 and self._rejoined_mask.any()
                                 and jax.tree.leaves(state.comp)):
                             # rejoining clients are NEW clients: their

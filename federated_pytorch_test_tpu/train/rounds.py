@@ -86,6 +86,23 @@ class RoundKernel:
         # fault injection + robust aggregation validate at construction,
         # not mid-run inside jit
         self.faults = FaultSpec.parse(cfg.fault_spec)
+        # soak campaign (campaign/): the trace-driven schedule that owns
+        # the fault families per round.  _campaign_tick swaps self.faults
+        # for the window's derived spec at every round entry; the parsed
+        # base (the disabled spec — campaign and fault_spec are mutually
+        # exclusive) keeps mode/scale/clients defaults.  None = off, the
+        # literal seed path.  The floor is the resume re-fire guard for
+        # deterministic preempt_at events (same role _preempt_armed
+        # plays for the Bernoulli preempt= family); the last-emitted
+        # hour drives transition-only `campaign` record emission.
+        from federated_pytorch_test_tpu.campaign.schedule import (
+            CampaignSchedule)
+        self.campaign = CampaignSchedule.parse(
+            getattr(cfg, "campaign_spec", "none"))
+        self._campaign_base_faults = self.faults
+        self._campaign_floor = -1
+        self._campaign_window = None
+        self._campaign_last_hour = None
         self.mean_fn = make_robust_mean(cfg.robust_agg,
                                         trim_frac=cfg.trim_frac,
                                         clip_mult=cfg.clip_mult)
@@ -143,6 +160,18 @@ class RoundKernel:
                 sampling=getattr(cfg, "cohort_sampling", "uniform"))
 
     @property
+    def _churn_live(self) -> bool:
+        """Can THIS run's membership ledger ever move?  True for a
+        static join=/leave= fault family and for any campaign whose
+        schedule carries churn — sticky across windows, because the
+        ledger meta, the rejoin resets and the v9 round fields must not
+        flap when a campaign window happens to zero the churn
+        probabilities (a resume from such a window would otherwise lose
+        the ledger)."""
+        return (self.faults.churn_enabled
+                or (self.campaign is not None and self.campaign.has_churn))
+
+    @property
     def _pop_active(self) -> bool:
         """Population mode live (registered clients ≫ cohort)?  False for
         both population-off and the identity registry, so every guarded
@@ -176,6 +205,20 @@ class RoundKernel:
                 "bb_update: both can mask clients out of a round, and the "
                 "BB spectral history (x0/yhat0 deltas) assumes every "
                 "client moves every round (consensus_multi.py:242-278)")
+        if self.campaign is not None:
+            if self.faults.enabled:
+                raise ValueError(
+                    "campaign_spec and fault_spec are mutually exclusive: "
+                    "the campaign schedule OWNS the fault families' "
+                    "probabilities per round (fold static fault knobs "
+                    "into the campaign spec instead)")
+            if cfg.bb_update:
+                raise ValueError(
+                    "campaign_spec is incompatible with bb_update: the "
+                    "campaign's arrival/fault windows mask clients out "
+                    "of rounds, and the BB spectral history assumes "
+                    "every client moves every round "
+                    "(consensus_multi.py:242-278)")
         if cfg.async_rounds:
             if cfg.bb_update:
                 raise ValueError(
@@ -390,7 +433,7 @@ class RoundKernel:
                                               churn_counts)
         quarantined = int(np.sum(self._quarantine > 0))
         if (not faults.enabled and quarantined == 0
-                and self._pop_slot_mask is None):
+                and self._pop_slot_mask is None and self.campaign is None):
             if cfg.participation >= 1.0:
                 dev, host = self._ones_mask, np.ones(cfg.K, np.float32)
             else:
@@ -405,7 +448,7 @@ class RoundKernel:
             # control-plane cohort rung: inactive slots sit the round
             # out entirely (same non-participant semantics as sampling)
             base = base * self._pop_slot_mask
-        if faults.churn_enabled:
+        if self._churn_live:
             # a departed client is out of the round entirely — not
             # sampled, not faulted, not counted; the mean renormalizes
             # over live members through the usual psum(w) denominator
@@ -433,7 +476,7 @@ class RoundKernel:
                 "straggled": comm * straggle,
                 "corrupted": corrupt,
             }
-            if faults.churn_enabled:
+            if self._churn_live:
                 self._client_round["members"] = \
                     self._members.astype(np.float32)
         csh = client_sharding(self.mesh)
@@ -453,7 +496,7 @@ class RoundKernel:
         counts (empty when churn is off, keeping v8 records byte-
         identical)."""
         faults = self.faults
-        if not faults.churn_enabled:
+        if not self._churn_live:
             return {}
         if self._pop_active:
             # population mode ticks the WHOLE registry roster: churn is
@@ -499,6 +542,53 @@ class RoundKernel:
         return {"members_active": int(self._members.sum()),
                 "joined": int(joined.sum()),
                 "left": int(left.sum())}
+
+    def _campaign_tick(self, rounds_done: int, nloop: int, ci: int,
+                       nadmm: int, checkpoint_path) -> None:
+        """Apply the campaign schedule's window for round ``rounds_done``.
+
+        Swaps ``self.faults`` for the window's derived spec — every
+        probability then flows through the EXISTING seeded families
+        (tags 47/67) with the campaign seed — and stashes the window for
+        ``_emit_round_obs``'s transition-only ``campaign`` record.  A
+        deterministic ``preempt_at`` event raises
+        :class:`CollectiveTimeoutError` exactly like the Bernoulli
+        ``preempt=`` family, after the newest checkpoint is durable;
+        ``_campaign_floor`` (the resumed segment's starting round) keeps
+        the deterministic event from re-firing forever on resume —
+        the same one-shot contract ``_preempt_armed`` gives tag 71.
+        """
+        if self.campaign is None:
+            return
+        w = self.campaign.window(rounds_done)
+        self.faults = self.campaign.spec_for(
+            w, base=self._campaign_base_faults)
+        self._campaign_window = w
+        if (w.preempt_now and rounds_done > self._campaign_floor
+                and rounds_done > 0 and checkpoint_path is not None):
+            if self._ckpt_writer is not None:
+                self._ckpt_writer.wait()
+            from federated_pytorch_test_tpu.parallel.mesh import (
+                CollectiveTimeoutError)
+            raise CollectiveTimeoutError(
+                f"campaign preemption at round {rounds_done} "
+                f"(virtual hour {w.hour}): campaign spec preempt_at "
+                f"scheduled this round", round_index=rounds_done)
+
+    def _emit_campaign_record(self, obs, round_index: int) -> None:
+        """Transition-only ``campaign`` record emission: the segment's
+        first completed round, every virtual-hour boundary, and any
+        post-resume re-run of a preempted round — the exact rule
+        ``CampaignSchedule.expected_emissions`` re-derives for
+        ``control.replay``.  Emitted right AFTER the round record it
+        rides with (file order == replay order)."""
+        w = self._campaign_window
+        if w is None or w.round_index != round_index:
+            return
+        if (self._campaign_last_hour is None
+                or w.hour != self._campaign_last_hour or w.preempt_now):
+            obs.campaign_event(self.campaign.record_fields(w))
+        self._campaign_last_hour = w.hour
 
     def _maybe_preempt(self, nloop: int, ci: int, nadmm: int,
                        rounds_done: int, checkpoint_path) -> None:
@@ -581,7 +671,7 @@ class RoundKernel:
             # cohort rung: an inactive slot neither dispatches nor has
             # anything in flight voided — its ledger rows just sit
             base = base * self._pop_slot_mask
-        if faults.churn_enabled:
+        if self._churn_live:
             # departed clients neither dispatch nor deliver (the
             # membership tick already voided their in-flight slots)
             base = base * self._members.astype(np.float32)
@@ -649,7 +739,7 @@ class RoundKernel:
                 "staleness": np.where(arrive, stale, -1).astype(np.int64),
                 "admitted": admit.astype(np.float32),
             }
-            if faults.churn_enabled:
+            if self._churn_live:
                 self._client_round["members"] = \
                     self._members.astype(np.float32)
         csh = client_sharding(self.mesh)
@@ -711,8 +801,8 @@ class RoundKernel:
         meta = {}
         meta.update(mesh_geometry_meta(
             devices=self.D, processes=jax.process_count(), K=self.cfg.K,
-            members=self._members if self.faults.churn_enabled else None))
-        if self.faults.churn_enabled:
+            members=self._members if self._churn_live else None))
+        if self._churn_live:
             meta["members_joined"] = np.asarray(self._members_joined,
                                                 np.int64)
             meta["members_left"] = np.asarray(self._members_left, np.int64)
@@ -764,7 +854,7 @@ class RoundKernel:
                 self._async_arrival = np.full(self.cfg.K, -1, np.int64)
                 self._async_birth = np.zeros(self.cfg.K, np.int64)
                 self._async_rejected = 0
-        if self.faults.churn_enabled:
+        if self._churn_live:
             if "members" in meta:
                 self._members = np.asarray(meta["members"], bool)
                 self._members_joined = int(meta.get("members_joined", 0))
@@ -910,6 +1000,10 @@ class RoundKernel:
             # record right behind the round record (schema v10)
             self._emit_client_record(obs, round_index, N, loss_host,
                                      cl_nrm, cl_dist)
+        if self.campaign is not None:
+            # the campaign window transition, if any, rides right behind
+            # the round record too (schema v12)
+            self._emit_campaign_record(obs, round_index)
         if obs.enabled:
             rspan = (rrec or {}).get("span_id")
             for nm, cat, s0, s1 in phase_marks:

@@ -11,8 +11,24 @@ The contract under test, on the 8-device virtual CPU mesh:
   (params0) survive a donated run;
 - ``--async-checkpoint`` + donation + fusion together still honor the
   kill/resume contract.
+
+The two fused+donated checks run in a crash-isolating subprocess
+(``_run_isolated``): on some jaxlib CPU builds the fused+donated program
+aborts in native code (SIGABRT), which would kill the whole tier-1
+pytest process and hide every test that sorts after this file.  The
+wrapper turns that native death into an explicit skip-with-reason while
+still running the full bitwise checks wherever the toolchain survives
+them.  Both checks share ONE memoized child (a single jax import; the
+second check's program is an in-process compile-cache hit), and checks
+a crash prevented from running are retried in a fresh child.
+``FEDTPU_FUSED_CHECK=<name,...|all> python tests/test_fused.py`` is the
+child entry point.
 """
 
+import os
+import signal
+import subprocess
+import sys
 import warnings
 
 import numpy as np
@@ -157,21 +173,14 @@ class TestFusedEquivalence:
             assert ra["loss"] == rb["loss"]
             assert ra["bytes_fused"] == rb["bytes_fused"] > 0
 
-    @pytest.mark.slow
-    def test_fused_with_donation_matches_too(self, data):
+    def test_fused_with_donation_matches_too(self):
         # the production TPU configuration: fused + donated, still
-        # bit-identical to the plain undonated loop.  slow-marked: the
-        # fused+donated program aborts inside jaxlib on the CPU backend
-        # of this toolchain (native SIGABRT, not a Python failure),
-        # which kills the whole tier-1 pytest process and hides every
-        # test that sorts after this file
-        _, s_plain, h_plain = run_trainer(small_cfg(donate=False), data)
-        _, s_fd, h_fd = run_trainer(
-            small_cfg(fused_rounds=True, donate=True), data)
-        for a, b in zip(param_leaves(s_plain), param_leaves(s_fd)):
-            np.testing.assert_array_equal(a, b)
-        for ra, rb in zip(h_plain, h_fd):
-            assert ra["loss"] == rb["loss"]
+        # bit-identical to the plain undonated loop — in a subprocess,
+        # because the fused+donated program can abort inside jaxlib on
+        # this toolchain's CPU backend (native SIGABRT, not a Python
+        # failure); isolation reports that as a skip instead of killing
+        # the pytest process
+        _run_isolated("fused_donate")
 
 
 class TestFusedFallback:
@@ -220,38 +229,165 @@ class TestDonation:
 
 
 class TestAsyncDonatedResume:
-    @pytest.mark.slow
-    def test_kill_resume_matches_sync_uninterrupted(self, data, tmp_path):
-        # slow-marked like test_fused_with_donation_matches_too: any
-        # fused + donated program dies in native jaxlib code on this
-        # toolchain's CPU backend, taking the whole pytest process with
-        # it (donate alone and fused alone both pass)
+    def test_kill_resume_matches_sync_uninterrupted(self):
         # the full PR 5 stack at once: fused + donated + async writer,
         # killed mid-run, resumed — must replay the plain synchronous
-        # run's history exactly (the abort-path writer drain makes the
-        # last submitted round durable)
-        cfg_kw = dict(fused_rounds=True, donate=True, Nadmm=3)
-        _, _, hist_full = run_trainer(small_cfg(**cfg_kw), data)
-        ck = str(tmp_path / "ck")
+        # run's history exactly.  Subprocess-isolated like
+        # test_fused_with_donation_matches_too: fused + donated can die
+        # in native jaxlib code on this toolchain's CPU backend (donate
+        # alone and fused alone both pass)
+        _run_isolated("kill_resume")
 
-        def bomb(state, rec):
-            if rec["nadmm"] == 1:
-                raise Killed
 
-        with pytest.raises(Killed):
-            run_trainer(small_cfg(async_checkpoint=True, **cfg_kw), data,
-                        checkpoint_path=ck, on_round=bomb)
-        _, _, hist_r = run_trainer(
-            small_cfg(async_checkpoint=True, **cfg_kw), data,
-            checkpoint_path=ck, resume=True)
-        assert len(hist_r) == len(hist_full)
-        for a, b in zip(hist_r, hist_full):
-            sa, sb = strip(a), strip(b)
-            assert sa.keys() == sb.keys()
-            for k in sa:
-                np.testing.assert_allclose(sa[k], sb[k], rtol=1e-5,
-                                           err_msg=f"history field {k}")
-        # rounds executed live carry the checkpoint-write timing (the
-        # restored prefix was packed into the checkpoint before the
-        # timing was stamped, so only the continued rounds have it)
-        assert "ckpt_write_seconds" in hist_r[-1]
+# ----------------------------------------------------------------------
+# crash isolation for the fused+donated checks
+
+
+def _check_fused_donate(data):
+    _, s_plain, h_plain = run_trainer(small_cfg(donate=False), data)
+    _, s_fd, h_fd = run_trainer(
+        small_cfg(fused_rounds=True, donate=True), data)
+    for a, b in zip(param_leaves(s_plain), param_leaves(s_fd)):
+        np.testing.assert_array_equal(a, b)
+    for ra, rb in zip(h_plain, h_fd):
+        assert ra["loss"] == rb["loss"]
+
+
+def _check_kill_resume(data, tmp):
+    cfg_kw = dict(fused_rounds=True, donate=True, Nadmm=3)
+    _, _, hist_full = run_trainer(small_cfg(**cfg_kw), data)
+    ck = os.path.join(tmp, "ck")
+
+    def bomb(state, rec):
+        if rec["nadmm"] == 1:
+            raise Killed
+
+    try:
+        run_trainer(small_cfg(async_checkpoint=True, **cfg_kw), data,
+                    checkpoint_path=ck, on_round=bomb)
+    except Killed:
+        pass
+    else:
+        raise AssertionError("mid-run kill did not fire")
+    _, _, hist_r = run_trainer(
+        small_cfg(async_checkpoint=True, **cfg_kw), data,
+        checkpoint_path=ck, resume=True)
+    assert len(hist_r) == len(hist_full)
+    for a, b in zip(hist_r, hist_full):
+        sa, sb = strip(a), strip(b)
+        assert sa.keys() == sb.keys()
+        for k in sa:
+            np.testing.assert_allclose(sa[k], sb[k], rtol=1e-5,
+                                       err_msg=f"history field {k}")
+    # rounds executed live carry the checkpoint-write timing (the
+    # restored prefix was packed into the checkpoint before the timing
+    # was stamped, so only the continued rounds have it)
+    assert "ckpt_write_seconds" in hist_r[-1]
+
+
+# kill_resume first: it survives this box's jaxlib while fused_donate
+# sometimes aborts natively, and a crash in the LAST check needs no
+# retry child — the surviving check's marker is already printed
+_CHILD_CHECKS = {"kill_resume": _check_kill_resume,
+                 "fused_donate": _check_fused_donate}
+
+# the checks share ONE child interpreter when the toolchain survives
+# them (a single jax import + data build, and the later check's
+# fused+donated program is an in-process compile-cache hit); a native
+# crash only charges the check it happened in — the checks that never
+# got to run are retried in a fresh child, so one flaky abort cannot
+# swallow the other check's coverage
+_CHILD_VERDICTS = {}  # check -> ("ok", None) | ("skip", sig) | ("fail", proc)
+
+
+def _spawn_checks(checks):
+    env = dict(os.environ, FEDTPU_FUSED_CHECK=",".join(checks),
+               JAX_PLATFORMS="cpu")
+    if "xla_force_host_platform_device_count" not in env.get("XLA_FLAGS",
+                                                             ""):
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8"
+                            ).strip()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                          env=env, capture_output=True, text=True,
+                          timeout=600)
+    remaining = list(checks)
+    while remaining and f"FUSED_CHECK_OK:{remaining[0]}" in proc.stdout:
+        _CHILD_VERDICTS[remaining.pop(0)] = ("ok", None)
+    if not remaining:
+        return
+    if proc.returncode < 0:
+        # the first unfinished check crashed natively; the ones after it
+        # never ran — give them their own child
+        _CHILD_VERDICTS[remaining[0]] = ("skip", -proc.returncode)
+        if remaining[1:]:
+            _spawn_checks(remaining[1:])
+    else:
+        for c in remaining:
+            _CHILD_VERDICTS[c] = ("fail", proc)
+
+
+def _run_isolated(check: str) -> None:
+    """Run the fused+donated checks in a shared child interpreter.
+
+    A native abort (negative returncode) is reported as an explicit
+    skip naming the signal — never a silent pass — while a Python-level
+    failure in the child fails this test with the child's output.
+    Checks the crash prevented from running are retried in a fresh
+    child, so a single abort never hides the other check's verdict.
+    """
+    if check not in _CHILD_VERDICTS:
+        _spawn_checks([c for c in _CHILD_CHECKS
+                       if c not in _CHILD_VERDICTS])
+    verdict, info = _CHILD_VERDICTS[check]
+    if verdict == "ok":
+        return
+    if verdict == "skip":
+        try:
+            signame = signal.Signals(info).name
+        except ValueError:
+            signame = f"signal {info}"
+        pytest.skip(
+            f"fused+donated child died with {signame}: jaxlib aborts in "
+            "native code on this toolchain's CPU backend (module "
+            "docstring) — reported as skip, not silent pass")
+    raise AssertionError(
+        f"isolated fused check {check!r} failed "
+        f"(rc={info.returncode}):\n{info.stdout[-2000:]}"
+        f"\n{info.stderr[-2000:]}")
+
+
+if __name__ == "__main__":
+    # child entry: FEDTPU_FUSED_CHECK is a comma-separated list of
+    # checks to run in order in this process ("all" = every check), one
+    # FUSED_CHECK_OK:<name> marker per completion; compile cache shared
+    # with the pytest parent
+    _name = os.environ.get("FEDTPU_FUSED_CHECK", "")
+    _names = (list(_CHILD_CHECKS) if _name == "all"
+              else [c for c in _name.split(",") if c])
+    if not _names or any(c not in _CHILD_CHECKS for c in _names):
+        print(f"unknown FEDTPU_FUSED_CHECK={_name!r} "
+              f"(expected 'all' or comma-joined {sorted(_CHILD_CHECKS)})",
+              file=sys.stderr)
+        sys.exit(2)
+    from federated_pytorch_test_tpu.utils.compile_cache import (
+        enable_persistent_compile_cache,
+    )
+
+    enable_persistent_compile_cache(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    _data = FederatedCifar10(K=K, batch=16, limit_per_client=32,
+                             limit_test=32)
+    for _check in _names:
+        if _check == "kill_resume":
+            import tempfile
+
+            with tempfile.TemporaryDirectory() as _tmp:
+                _check_kill_resume(_data, _tmp)
+        else:
+            _CHILD_CHECKS[_check](_data)
+        print(f"FUSED_CHECK_OK:{_check}", flush=True)
+    sys.exit(0)
