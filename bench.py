@@ -1600,6 +1600,185 @@ def _soak() -> int:
                              "--threshold", "5"])
 
 
+_SERVE_BASELINE = "artifacts/SERVE_BASELINE.json"
+_SERVE_METRIC = "serve_qps_chip"
+#: the serving gate's traffic: seeded constant-rate requests (the ±10%%
+#: per-round jitter still applies), pad-to-bucket batching over three
+#: static shapes, a hot-swap every 2 rounds, and a total label shift
+#: injected from round 4 on so the served eval stream drifts and the
+#: watchdog/policy loop (health window 2, streak 1, act mode) has
+#: something to close on.  Every non-timing field in the record stream
+#: is a pure function of this spec (PARITY.md v0.14), so replay
+#: verification gates exact values; only qps/p99/swap-gap are timings.
+_SERVE_SPEC = ("qps=16,round_minutes=0.5,buckets=8+32+128,swap_every=2,"
+               "drift_at=4,seed=3")
+
+
+def _serve_engine_run(tmp: str):
+    """Tiny REAL training run with the serving plane on: 8 rounds of
+    the 2-block net, consensus weights hot-swapped every 2 rounds,
+    seeded traffic served at every round boundary, drift injected from
+    round 4.  Returns the run's JSONL path."""
+    import flax.linen as nn
+
+    from federated_pytorch_test_tpu.data.cifar10 import FederatedCifar10
+    from federated_pytorch_test_tpu.models.base import (
+        BlockModule,
+        elu,
+        flatten,
+        max_pool_2x2,
+        pairs,
+    )
+    from federated_pytorch_test_tpu.train import (
+        AdmmConsensus,
+        BlockwiseFederatedTrainer,
+        FederatedConfig,
+    )
+
+    class ServeNet(BlockModule):
+        @nn.compact
+        def __call__(self, x, train=True):
+            x = max_pool_2x2(elu(nn.Conv(4, (5, 5), strides=(2, 2),
+                                         name="conv1")(x)))
+            return nn.Dense(10, name="fc1")(flatten(x))
+
+        def param_order(self):
+            return pairs("conv1", "fc1")
+
+        def train_order_block_ids(self):
+            return [[0, 1], [2, 3]]
+
+        def linear_layer_ids(self):
+            return [1]
+
+    K = 8
+    # Nloop * blocks * Nadmm = 2 * 2 * 2 = 8 rounds: enough for 4 swaps
+    # and 4 drifted serving rounds after drift_at=4
+    cfg = FederatedConfig(K=K, Nloop=2, Nepoch=1, Nadmm=2,
+                          default_batch=16, check_results=False,
+                          admm_rho0=0.1, seed=0,
+                          serve_spec=_SERVE_SPEC, control="act",
+                          health_action="warn", health_window=2,
+                          health_streak=1, health_tput_frac=0.75,
+                          obs_dir=os.path.join(tmp, "obs"),
+                          obs_sinks="jsonl")
+    data = FederatedCifar10(K=K, batch=16, limit_per_client=16,
+                            limit_test=16)
+    trainer = BlockwiseFederatedTrainer(ServeNet(), cfg, data,
+                                        AdmmConsensus())
+    trainer.obs_run_name = "serve"
+    trainer.run(log=lambda m: None)
+    return os.path.join(tmp, "obs", "serve.jsonl")
+
+
+def _serve_bench() -> int:
+    """``bench.py --serve-bench``: the no-TPU CI gate for the serving
+    plane (serve/).  Runs a tiny training run with seeded traffic
+    served at every round boundary, verifies the stream with
+    ``control.replay`` (the pure serve fields must re-derive from the
+    header config alone — any divergence fails the gate), and emits a
+    bench-shaped artifact (``artifacts/serve.json``) whose headline is
+    sustained QPS per chip, plus p99 latency and the worst hot-swap
+    publish gap, diffed against the committed
+    ``artifacts/SERVE_BASELINE.json`` via obs/compare.py — exit 1 on
+    regression (QPS down, p99/swap-gap up).  The request counts,
+    batching plan, swap sequence, and drift schedule are seed-
+    deterministic; only the latency/QPS numbers are timings, hence the
+    wide noise band."""
+    # must land before this process's first jax import
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import tempfile
+
+    out = {
+        "metric": _SERVE_METRIC,
+        "unit": "requests/sec/chip (batched online inference)",
+        "measured": True,
+        "baseline_ref": _SERVE_BASELINE,
+        "serve_spec": _SERVE_SPEC,
+    }
+    t0 = time.perf_counter()  # graftlint: disable=JG104
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            path = _serve_engine_run(tmp)
+            import jax
+
+            from federated_pytorch_test_tpu.control.replay import replay
+            from federated_pytorch_test_tpu.obs.report import (
+                read_records,
+                summarize,
+            )
+
+            n_chips = jax.device_count()
+            records = read_records(path)
+            s = summarize(records)
+            errors, stats = replay(records)
+    except Exception as e:      # noqa: BLE001 — report, don't traceback
+        out["error"] = f"serve bench run failed: {type(e).__name__}: {e}"
+    else:
+        qps = s.get("serve_qps_mean") or 0.0
+        out["value"] = round(qps / max(n_chips, 1), 3)
+        out["serve_p99_ms"] = s.get("serve_p99_ms_max")
+        out["serve_swap_gap_seconds"] = s.get("serve_swap_gap_max")
+        out["serve_qps_mean"] = s.get("serve_qps_mean")
+        out["serve_p50_ms_mean"] = s.get("serve_p50_ms_mean")
+        # deterministic section (seed-derived, replay-checked): info
+        # direction in the diff, but divergence already failed replay
+        out["serve_records"] = s.get("serve_records")
+        out["serve_requests_total"] = s.get("serve_requests_total")
+        out["serve_batches_total"] = s.get("serve_batches_total")
+        out["serve_padding_waste_frac"] = s.get("serve_padding_waste_frac")
+        out["serve_swaps"] = s.get("serve_swaps")
+        out["serve_drift_rounds"] = s.get("serve_drift_rounds")
+        out["serve_drift_alerts"] = s.get("serve_drift_alerts")
+        out["serve_forced_refreshes"] = s.get("serve_forced_refreshes")
+        out["serve_replay_errors"] = len(errors)
+        out["serve_replay_records"] = stats
+        if errors:
+            out["error"] = ("serve stream failed replay verification: "
+                            + errors[0])
+        elif (s.get("serve_swaps", 0) < 2
+                or not s.get("serve_drift_rounds")):
+            out["error"] = (
+                "serve bench did not exercise the hot-swap/drift path "
+                f"(swaps={s.get('serve_swaps')}, "
+                f"drift_rounds={s.get('serve_drift_rounds')}); the "
+                "serve_spec must force >= 2 swaps and a drifted tail")
+    out["serve_wall_seconds"] = round(time.perf_counter() - t0, 2)  # graftlint: disable=JG104
+    out["captured_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    out["git"] = _git_describe()
+    art_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "artifacts")
+    path = os.path.join(art_dir, "serve.json")
+    try:
+        os.makedirs(art_dir, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+    except OSError as e:
+        print(f"bench: cannot write serve artifact: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(out))
+    if out.get("error"):
+        return 1
+    baseline = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            _SERVE_BASELINE)
+    if not os.path.exists(baseline):
+        print(f"bench: no committed {_SERVE_BASELINE}; serve gate skipped "
+              "(commit the emitted artifacts/serve.json there to arm it)",
+              file=sys.stderr)
+        return 0
+    from federated_pytorch_test_tpu.obs import compare as obs_compare
+
+    # qps/p99/swap-gap are timings on shared CI boxes: gate only on
+    # halving/doubling-scale movement, anything subtler is info
+    return obs_compare.main([path, "--baseline", baseline,
+                             "--threshold", "50"])
+
+
 if __name__ == "__main__":
     if "--measure" in sys.argv[1:]:
         sys.exit(_measure_child())
@@ -1609,4 +1788,6 @@ if __name__ == "__main__":
         sys.exit(_population_bench())
     if "--soak" in sys.argv[1:]:
         sys.exit(_soak())
+    if "--serve-bench" in sys.argv[1:]:
+        sys.exit(_serve_bench())
     main()

@@ -343,6 +343,21 @@ class ControlPolicy:
                 if d:
                     self.cur_batch = new
                     out.append(d)
+        elif rule == "serve_drift":
+            # serving rung (serve/): live served accuracy collapsed vs
+            # its EMA baseline — arm a forced weight refresh at the next
+            # serve tick.  A host-read flag like cohort_frac: the kernel
+            # republishes the CURRENT consensus without bumping the pure
+            # weights_version sequence, so replay is untouched; a
+            # serving-off run logs a skip (rounds._apply_round_control).
+            d = self._decide(
+                ridx, "refresh_serving", "serve_swap", None, "resync",
+                SCOPE_ROUND,
+                "served accuracy drifted below the EMA envelope: "
+                "republish the consensus weights to the serving plane",
+                observed=obs, threshold=thr, streak=stk)
+            if d:
+                out.append(d)
         return out
 
     def _observe_client(self, rec: Dict[str, Any]) -> List[Decision]:

@@ -360,6 +360,76 @@ def check_campaign_records(segments: List[List[Dict[str, Any]]],
     return checked
 
 
+def check_serve_records(segments: List[List[Dict[str, Any]]],
+                        errors: List[str]) -> int:
+    """Verify recorded serving rounds against the serve schedule.
+
+    Serving plane (PARITY.md v0.14): every round a serving segment
+    completes emits exactly one ``serve`` record whose PURE fields —
+    ``weights_version`` (= 1 + round // swap_every), the tag-83
+    ``requests`` draw, the batch plan (``batches``/``padded_slots``/
+    ``padding_waste_frac``), ``swap`` and ``drift_injected`` — are
+    functions of (header ``serve_spec``, round_index) alone, so the
+    whole sequence re-derives from the header and must match the stream
+    field-by-field, bit-exactly.  Latency/QPS/swap-gap/accuracy fields
+    are advisory wall-clock telemetry and are NOT compared.  A serve
+    record in a serving-off segment is a forgery, exactly like cohorts
+    and campaign windows.
+    """
+    from federated_pytorch_test_tpu.serve.batcher import (
+        SERVE_FIELDS, ServeSchedule)
+
+    checked = 0
+    for si, segment in enumerate(segments):
+        header = next((r for r in segment
+                       if r.get("event") == "run_header"), None)
+        config = (header or {}).get("config")
+        srecs = [r for r in segment if r.get("event") == "serve"]
+        spec = (config or {}).get("serve_spec") \
+            if isinstance(config, dict) else None
+        try:
+            sched = ServeSchedule.parse(spec)
+        except ValueError as e:
+            errors.append(f"segment {si}: unparseable serve_spec "
+                          f"{spec!r} in the header config: {e}")
+            continue
+        if sched is None:
+            if srecs:
+                errors.append(
+                    f"segment {si}: {len(srecs)} serve record(s) but "
+                    "the header config has serving off (or no config "
+                    "snapshot) — cannot have been produced by this "
+                    "configuration")
+            continue
+        rounds = [r["round_index"] for r in segment
+                  if r.get("event") == "round"
+                  and isinstance(r.get("round_index"), int)]
+        expected = sched.expected_records(rounds)
+        checked += len(srecs)
+        for i in range(max(len(expected), len(srecs))):
+            if i >= len(expected):
+                errors.append(
+                    f"segment {si} serve record {i}: recorded but NOT "
+                    "derivable from the schedule (round_index="
+                    f"{srecs[i].get('round_index')!r})")
+                continue
+            ridx, fields = expected[i]
+            if i >= len(srecs):
+                errors.append(
+                    f"segment {si} serve record {i}: derived from the "
+                    f"schedule (round {ridx}) but missing from the stream")
+                continue
+            got = {k: srecs[i].get(k) for k in SERVE_FIELDS}
+            if got != fields:
+                diff = ", ".join(
+                    f"{k}: recorded {got[k]!r} != derived {fields[k]!r}"
+                    for k in SERVE_FIELDS if got[k] != fields[k])
+                errors.append(
+                    f"segment {si} serve record {i} (round {ridx}) "
+                    f"diverges: {diff}")
+    return checked
+
+
 def replay(records: List[Dict[str, Any]]) -> Tuple[List[str], Dict[str, int]]:
     """Full replay check; returns (errors, stats)."""
     errors: List[str] = []
@@ -369,11 +439,13 @@ def replay(records: List[Dict[str, Any]]) -> Tuple[List[str], Dict[str, int]]:
     n_reshape = check_reshape_records(segments, errors)
     n_cohort = check_cohort_records(segments, errors)
     n_campaign = check_campaign_records(segments, errors)
+    n_serve = check_serve_records(segments, errors)
     return errors, {"segments": len(segments), "policy_records": n_policy,
                     "supervisor_records": n_sup,
                     "reshape_records": n_reshape,
                     "cohort_records": n_cohort,
-                    "campaign_records": n_campaign}
+                    "campaign_records": n_campaign,
+                    "serve_records": n_serve}
 
 
 def selftest() -> str:
@@ -554,6 +626,34 @@ def selftest() -> str:
         # campaign record on a campaign-off stream is a forgery
         errors16, _ = replay(camp_base + camp_recs[:1])
         assert errors16 and "no campaign" in errors16[0], errors16
+
+        # serve records: the pure fields re-derive from the header's
+        # serve_spec + completed rounds; tampering the version, dropping
+        # a round, or forging a record on a serving-off stream diverge
+        from federated_pytorch_test_tpu.serve.batcher import ServeSchedule
+        sspec = "qps=16,round_minutes=0.5,swap_every=2,seed=5"
+        ssched = ServeSchedule.parse(sspec)
+        d7 = os.path.join(d, "serve")
+        os.makedirs(d7, exist_ok=True)
+        serve_base = read_records(synth(d7, [0.1] * 4, name="serve"))
+        served = [dict(r, config=dict(config, serve_spec=sspec))
+                  if r.get("event") == "run_header" else r
+                  for r in serve_base]
+        serve_recs = [dict({"event": "serve", "schema": SCHEMA_VERSION,
+                            "run_id": "x", "serve_qps": 123.4}, **fields)
+                      for _, fields in ssched.expected_records(range(4))]
+        errors17, stats17 = replay(served + serve_recs)
+        assert not errors17, errors17
+        assert stats17["serve_records"] == 4, stats17
+        bad_serve = [dict(c) for c in serve_recs]
+        bad_serve[2]["weights_version"] += 1
+        errors18, _ = replay(served + bad_serve)
+        assert errors18 and "diverges" in errors18[0], errors18
+        errors19, _ = replay(served + serve_recs[:-1])
+        assert errors19 and "missing from the stream" in errors19[0], \
+            errors19
+        errors20, _ = replay(serve_base + serve_recs[:1])
+        assert errors20 and "serving off" in errors20[0], errors20
         json.dumps(stats)  # stats stay JSON-representable
     return "control replay selftest: OK (decisions reproduce; tampering detected)"
 
@@ -590,8 +690,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     print(f"replay OK: {stats['policy_records']} policy decision(s), "
           f"{stats['supervisor_records']} supervisor record(s), "
           f"{stats['reshape_records']} reshape record(s), "
-          f"{stats['cohort_records']} cohort record(s) and "
-          f"{stats['campaign_records']} campaign record(s) reproduce "
+          f"{stats['cohort_records']} cohort record(s), "
+          f"{stats['campaign_records']} campaign record(s) and "
+          f"{stats['serve_records']} serve record(s) reproduce "
           f"across {stats['segments']} segment(s)")
     return 0
 

@@ -82,6 +82,16 @@ def _direction(name: str) -> int:
         return +1
     if name.endswith("_rounds_lost"):
         return -1
+    # serving-plane gate (schema v13; bench.py --serve-bench): sustained
+    # QPS regresses DOWN, tail latency and the hot-swap publish gap
+    # regress UP — the rest of the serve_* section (padding waste,
+    # request counts) stays info-direction via the startswith passthrough
+    if name.startswith("serve_qps"):
+        return +1
+    if name.startswith("serve_p99"):
+        return -1
+    if name.startswith("serve_swap_gap"):
+        return -1
     return 0        # unknown: report the delta, never a verdict
 
 
@@ -245,12 +255,15 @@ def load_source(path: str) -> Dict[str, Any]:
                 # rule, the K/cohort/wall fields report as info
                 # soak_* covers bench.py --soak: availability/rounds-lost
                 # gate by the direction rules, the rest report as info
+                # serve_* covers bench.py --serve-bench: qps/p99/swap-gap
+                # gate by the direction rules, the rest report as info
                 if (k.endswith("_ips_chip") or k == "mfu"
                         or k.endswith("_wire_bytes")
                         or k.endswith("_savings_ratio")
                         or k.startswith("smoke_")
                         or k.startswith("population_")
-                        or k.startswith("soak_")):
+                        or k.startswith("soak_")
+                        or k.startswith("serve_")):
                     v = _num(val)
                     if v is not None:
                         src["metrics"][k] = v
@@ -478,6 +491,35 @@ def selftest() -> None:
         assert _direction("rounds_lost") == -1
         assert _direction("soak_availability_pct") == +1
         assert _direction("soak_rounds_lost") == -1
+        # serving gate: dropping QPS or growing tail latency / swap gap
+        # REGRESSES; padding waste is info-direction (reported, not gated)
+        assert _direction("serve_qps_chip") == +1
+        assert _direction("serve_throughput") == +1
+        assert _direction("serve_p99_ms") == -1
+        assert _direction("serve_swap_gap_seconds") == -1
+        assert _direction("serve_padding_waste_frac") == 0
+        serve = {"metric": "serve_qps_chip", "value": 400.0,
+                 "unit": "requests/sec/chip", "measured": True,
+                 "serve_p99_ms": 12.0, "serve_swap_gap_seconds": 0.05,
+                 "serve_padding_waste_frac": 0.2}
+        vbase = os.path.join(d, "serve_base.json")
+        with open(vbase, "w") as f:
+            json.dump(serve, f)
+        vsame = os.path.join(d, "serve_same.json")
+        with open(vsame, "w") as f:
+            json.dump(dict(serve, baseline_ref=vbase), f)
+        assert run([vsame]) == 0, "serve self-vs-self must exit 0"
+        vbad = os.path.join(d, "serve_bad.json")
+        with open(vbad, "w") as f:
+            json.dump(dict(serve, value=200.0, serve_p99_ms=40.0), f)
+        assert run([vbad, "--baseline", vbase]) == 1, \
+            "QPS drop / p99 growth must exit 1"
+        # a padding-waste-only change must NOT gate (info direction)
+        vwaste = os.path.join(d, "serve_waste.json")
+        with open(vwaste, "w") as f:
+            json.dump(dict(serve, serve_padding_waste_frac=0.9), f)
+        assert run([vwaste, "--baseline", vbase]) == 0, \
+            "padding-waste delta must stay info-direction"
 
 
 if __name__ == "__main__":

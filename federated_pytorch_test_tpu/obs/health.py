@@ -23,6 +23,12 @@ is caught even when no JSONL sink is configured.  Rules:
   consensus fold the same round they appear, one to two rounds BEFORE
   the (staged) loss goes non-finite — tripping here is what keeps a
   clean checkpoint slot alive for the restart supervisor to resume from
+- ``serve_drift``         — (serving runs, schema v13) live served
+  accuracy below ``tput_frac`` x its own warmed EMA baseline for
+  ``streak`` serving rounds.  Fed ``serve`` records through
+  ``observe_serve`` (recorder.serve_event) — the eval-stream half of
+  the continuous-learning loop; in act mode the control plane answers
+  with a ``refresh_serving`` intervention (control/policy.py)
 
 Each trip emits a structured ``alert`` record into the SAME stream the
 round records use.  What happens next is ``health_action``:
@@ -97,6 +103,10 @@ class HealthMonitor:
         self._ips: deque = deque(maxlen=self.window)
         # async buffer_depth trajectory
         self._depths: deque = deque(maxlen=self.window)
+        # served-accuracy EMA baseline (serve_drift, schema v13 serve
+        # records via observe_serve — warmed like the loss EMA)
+        self._serve_ema: Optional[float] = None
+        self._serve_ema_n = 0
 
     # -- rule plumbing ---------------------------------------------------
 
@@ -254,6 +264,40 @@ class HealthMonitor:
 
         # zero_progress: no client contributed
         n_active = rec.get("n_active")
+        self._check_zero_progress(rec, n_active)
+
+    def observe_serve(self, rec: Dict[str, Any]) -> None:
+        """Evaluate the ``serve_drift`` rule against one ``serve``
+        record (schema v13; fed by ``RunRecorder.serve_event`` — the
+        round records never reach this path).  Never raises."""
+        try:
+            self._observe_serve(rec)
+        except Exception:
+            pass
+
+    def _observe_serve(self, rec: Dict[str, Any]) -> None:
+        acc = rec.get("serve_accuracy")
+        if not _finite(acc):
+            return
+        # serve_drift: live served accuracy collapsing below tput_frac x
+        # its own warmed EMA baseline — the same envelope discipline as
+        # loss_divergence, pointed at the eval stream
+        if self._serve_ema_n >= self.window and self._serve_ema is not None \
+                and self._serve_ema > 0:
+            floor = self.tput_frac * self._serve_ema
+            n = self._bump("serve_drift", acc < floor)
+            if n >= self.streak:
+                self._fire(rec, "serve_drift",
+                           f"served accuracy {acc:.4f} < {self.tput_frac}x "
+                           f"its EMA baseline ({self._serve_ema:.4f}) for "
+                           f"{n} serving rounds",
+                           observed=acc, threshold=floor, streak=n)
+        alpha = 2.0 / (self.window + 1.0)
+        self._serve_ema = (acc if self._serve_ema is None
+                           else (1 - alpha) * self._serve_ema + alpha * acc)
+        self._serve_ema_n += 1
+
+    def _check_zero_progress(self, rec: Dict[str, Any], n_active) -> None:
         n_ok = rec.get("n_ok")
         if _finite(n_active) or _finite(n_ok):
             stalled = ((_finite(n_active) and n_active <= 0)
@@ -323,3 +367,15 @@ def selftest() -> None:
         raise RunHealthAbort(mon2.tripped)
     except RunHealthAbort as e:
         assert e.alert["rule"] == "nonfinite_loss"
+
+    # serve_drift: a warmed accuracy baseline then a sustained collapse
+    # must alert; the warmup itself must not (cold start != drift)
+    mon3 = HealthMonitor(action="warn", streak=2, window=4)
+    for i in range(6):
+        mon3.observe_serve({"round_index": i, "serve_accuracy": 0.8})
+    assert not mon3.alerts, "steady serving accuracy must not alert"
+    for i in range(6, 9):
+        mon3.observe_serve({"round_index": i, "serve_accuracy": 0.0})
+    assert mon3.alerts and mon3.alerts[0]["rule"] == "serve_drift", \
+        mon3.alerts
+    assert mon3.alerts[0]["round_index"] == 7

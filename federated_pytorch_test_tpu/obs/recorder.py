@@ -404,6 +404,35 @@ class RunRecorder:
         rec.update(json_safe(fields))
         return self._emit(rec)
 
+    def serve_event(self, fields: Dict[str, Any]) -> Optional[dict]:
+        """Emit one ``serve`` record (schema v13; serve/).
+
+        ``fields`` is a serving-plane round tick: the pure subset
+        (:data:`~..serve.batcher.SERVE_FIELDS`) plus advisory
+        latency/QPS/eval telemetry.  Emitted right after the campaign
+        record slot in the round fan-out, so file order equals replay
+        order.  NOT fed to the controller — the pure subset is a
+        function of (serve_spec, round_index) that ``control.replay``
+        re-derives from the header alone, and the live policy engine
+        must see exactly the record sequence replay feeds it
+        (round/alert/client).  The eval-stream loop reaches the
+        controller through the health monitor instead: like ``round()``
+        the record IS fed to the watchdog's ``observe_serve`` (which
+        may emit a ``serve_drift`` alert — and alerts are policy input)
+        even when no sink is configured.
+        """
+        if not self.enabled and self.health is None:
+            return None
+        rec = {"event": "serve", "schema": SCHEMA_VERSION,
+               "run_id": self.run_id}
+        rec.update(json_safe(fields))
+        out = self._emit(rec) if self.enabled else rec
+        if self.health is not None:
+            observe = getattr(self.health, "observe_serve", None)
+            if observe is not None:
+                observe(rec)
+        return out
+
     def compile_event(self, fields: Dict[str, Any], *,
                       parent_span: Optional[str] = None) -> Optional[dict]:
         """Emit one ``compile`` record (schema v6; obs/costs.py).

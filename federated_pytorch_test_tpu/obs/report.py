@@ -215,6 +215,56 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             1 for r in camps if r.get("burst"))
         out["campaign_preempts"] = sum(
             1 for r in camps if r.get("preempt_now"))
+    # serving plane (schema v13; serve/): request/batch totals, the
+    # blended padding-waste fraction (padded slots over dispatched
+    # slots, NOT a mean of per-round fractions — rounds with more
+    # traffic weigh more), latency/QPS telemetry, the hot-swap count
+    # and worst publish gap, and the closed-loop drift signals.  All
+    # absent on serving-off streams so pre-v13 summaries are unchanged.
+    serves = [r for r in records if r.get("event") == "serve"]
+    out["serve_records"] = len(serves)
+    if serves:
+        def stot(key):
+            vals = [r[key] for r in serves
+                    if isinstance(r.get(key), (int, float))
+                    and not isinstance(r.get(key), bool)]
+            return sum(vals) if vals else None
+
+        def svals(key):
+            return [r[key] for r in serves
+                    if isinstance(r.get(key), (int, float))
+                    and not isinstance(r.get(key), bool)]
+
+        out["serve_requests_total"] = stot("requests")
+        out["serve_batches_total"] = stot("batches")
+        padded = stot("padded_slots") or 0
+        req = out["serve_requests_total"] or 0
+        out["serve_padding_waste_frac"] = (
+            round(padded / (req + padded), 6) if req + padded else None)
+        qps = svals("serve_qps")
+        out["serve_qps_mean"] = (
+            round(sum(qps) / len(qps), 3) if qps else None)
+        p50 = svals("serve_p50_ms")
+        out["serve_p50_ms_mean"] = (
+            round(sum(p50) / len(p50), 3) if p50 else None)
+        p99 = svals("serve_p99_ms")
+        out["serve_p99_ms_max"] = round(max(p99), 3) if p99 else None
+        gaps = svals("swap_gap_seconds")
+        out["serve_swap_gap_max"] = (
+            round(max(gaps), 6) if gaps else None)
+        out["serve_swaps"] = sum(1 for r in serves if r.get("swap"))
+        out["serve_forced_refreshes"] = sum(
+            1 for r in serves if r.get("forced_refresh"))
+        vers = [r["weights_version"] for r in serves
+                if isinstance(r.get("weights_version"), int)]
+        out["serve_weights_version_last"] = vers[-1] if vers else None
+        acc = svals("serve_accuracy")
+        out["serve_accuracy_last"] = (
+            round(acc[-1], 6) if acc else None)
+        out["serve_drift_rounds"] = sum(
+            1 for r in serves if r.get("drift_injected"))
+        out["serve_drift_alerts"] = sum(
+            1 for a in alerts if a.get("rule") == "serve_drift")
     out["intervention_timeline"] = [
         {"round_index": c.get("round_index"), "source": c.get("source"),
          "intervention": c.get("intervention"), "param": c.get("param"),
@@ -374,6 +424,34 @@ def format_report(s: Dict[str, Any]) -> str:
                 f"preempts={s.get('campaign_preempts', 0)}; phases: "
                 + ", ".join(s.get("campaign_phases") or []))
         row("campaign", msg)
+    if s.get("serve_records"):
+        msg = (f"{s['serve_records']} tick(s), "
+               f"{s.get('serve_requests_total') or 0:,} request(s)")
+        if s.get("serve_qps_mean") is not None:
+            msg += f", {s['serve_qps_mean']:,.1f} qps"
+        if s.get("serve_p50_ms_mean") is not None:
+            msg += (f", p50 {s['serve_p50_ms_mean']:.2f} ms / "
+                    f"p99 {s.get('serve_p99_ms_max', 0.0):.2f} ms")
+        row("serving", msg)
+        msg = (f"{s.get('serve_swaps', 0)} swap(s) to "
+               f"v{s.get('serve_weights_version_last')}")
+        if s.get("serve_swap_gap_max") is not None:
+            msg += f", worst gap {1e3 * s['serve_swap_gap_max']:.1f} ms"
+        if s.get("serve_forced_refreshes"):
+            msg += (f", {s['serve_forced_refreshes']} forced "
+                    "refresh(es)")
+        if s.get("serve_padding_waste_frac") is not None:
+            msg += (f", padding waste "
+                    f"{100.0 * s['serve_padding_waste_frac']:.1f} %")
+        row("serve swaps", msg)
+        if (s.get("serve_drift_rounds") or s.get("serve_drift_alerts")
+                or s.get("serve_accuracy_last") is not None):
+            msg = ""
+            if s.get("serve_accuracy_last") is not None:
+                msg += f"accuracy_last={s['serve_accuracy_last']:.4f} "
+            msg += (f"drift_rounds={s.get('serve_drift_rounds', 0)} "
+                    f"drift_alerts={s.get('serve_drift_alerts', 0)}")
+            row("serve drift", msg)
     if s.get("client_norm_drift_frac") is not None:
         row("cohort drift",
             f"{100.0 * s['client_norm_drift_frac']:+.1f} % mean "
@@ -437,10 +515,21 @@ def selftest() -> str:
                        "buffer_depth": i, "staleness_hist": [2, 0, 0],
                        "members_active": 2 - (i == 1), "joined": 0,
                        "left": 1 if i == 1 else 0})
+            # serving tick (schema v13): the pure subset + advisory
+            # telemetry, validated by the same read_records pass below
+            rec.serve_event({"round_index": i, "weights_version":
+                             1 + i // 2, "requests": 10 + i, "batches": 2,
+                             "padded_slots": 3, "padding_waste_frac": 0.2,
+                             "serve_p50_ms": 1.0, "serve_p99_ms": 2.0 + i,
+                             "serve_qps": 100.0, "serve_accuracy": 0.9,
+                             "drift_score": 0.0, "drift_injected": False,
+                             "swap": i % 2 == 0,
+                             **({"swap_gap_seconds": 0.01}
+                                if i % 2 == 0 else {})})
         rec.close()
         path = os.path.join(d, "selftest.jsonl")
         records = read_records(path)
-        assert len(records) == 5, f"expected 5 records, got {len(records)}"
+        assert len(records) == 8, f"expected 8 records, got {len(records)}"
         s = summarize(records)
         assert s["rounds"] == 3 and s["monotonic"], s
         assert s["bytes_on_wire_total"] == 300, s
@@ -458,11 +547,19 @@ def selftest() -> str:
         assert s["members_peak"] == 2 and s["members_min"] == 1, s
         assert s["joined_total"] == 0 and s["left_total"] == 1, s
         assert s["reshapes"] == 0, s
+        assert s["serve_records"] == 3, s
+        assert s["serve_requests_total"] == 33, s
+        assert s["serve_swaps"] == 2, s
+        assert s["serve_weights_version_last"] == 2, s
+        assert s["serve_p99_ms_max"] == 4.0, s
+        assert abs(s["serve_padding_waste_frac"] - 9 / 42) < 1e-6, s
+        assert s["serve_swap_gap_max"] == 0.01, s
         table = format_report(s)
         assert "async" in table, table
         assert "bytes fused" in table, table
         assert "comm overlap" in table, table
         assert "membership" in table, table
+        assert "serving" in table and "serve swaps" in table, table
     assert record_ips({"images": 256, "round_seconds": 0}) == float("inf")
     assert record_ips({"images": 0, "round_seconds": 0}) == 0.0
 
@@ -510,6 +607,22 @@ def selftest() -> str:
     assert "campaign" in soak_table, soak_table
     assert "supervisor/restart" in soak_table, soak_table
 
+    # serve drift aggregation: injected rounds and the watchdog's
+    # serve_drift alerts both surface in the summary/table
+    drift_stream = (
+        [{"event": "serve", "schema": 13, "run_id": "x",
+          "round_index": i, "weights_version": 1, "requests": 8,
+          "serve_accuracy": 1.0 - 0.5 * (i >= 2),
+          "drift_injected": i >= 2} for i in range(4)]
+        + [{"event": "alert", "schema": 13, "run_id": "x",
+            "round_index": 3, "rule": "serve_drift", "severity": "warn",
+            "message": "selftest", "action": "warn"}])
+    ds = summarize(drift_stream)
+    assert ds["serve_drift_rounds"] == 2, ds
+    assert ds["serve_drift_alerts"] == 1, ds
+    assert ds["serve_accuracy_last"] == 0.5, ds
+    assert "serve drift" in format_report(ds), format_report(ds)
+
     from federated_pytorch_test_tpu.campaign import clock as campaign_clock
     from federated_pytorch_test_tpu.campaign import (
         harness as campaign_harness)
@@ -518,6 +631,12 @@ def selftest() -> str:
     from federated_pytorch_test_tpu.control import replay as control_replay
     from federated_pytorch_test_tpu.obs import (
         clients, compare, health, profile, trace,
+    )
+    from federated_pytorch_test_tpu.serve import (
+        batcher as serve_batcher,
+        evalstream as serve_evalstream,
+        infer as serve_infer,
+        swap as serve_swap,
     )
 
     trace.selftest()
@@ -529,6 +648,10 @@ def selftest() -> str:
     campaign_schedule.selftest()
     campaign_clock.selftest()
     campaign_harness.selftest()
+    serve_batcher.selftest()
+    serve_swap.selftest()
+    serve_infer.selftest()
+    serve_evalstream.selftest()
     return (table
             + "\nobs trace selftest: OK (Chrome trace valid)"
             + "\nobs health selftest: OK (NaN streak alerted)"
@@ -538,6 +661,8 @@ def selftest() -> str:
             + "\nobs clients selftest: OK (anomaly ranking replayable)"
             + "\ncampaign selftests: OK (schedule pure; clock scales "
             "wall time only; harness maps knobs)"
+            + "\nserve selftests: OK (batcher deterministic; swap "
+            "never torn; predictor pads to buckets; drift scored)"
             + "\nobs report selftest: OK")
 
 

@@ -1,6 +1,6 @@
 """Versioned record schema for run telemetry.
 
-One run = one JSONL stream of nine event kinds:
+One run = one JSONL stream of ten event kinds:
 
 - ``run_header``  — emitted once when a run (or resumed segment) opens:
   config snapshot, mesh shape, jax/backend versions, git rev.
@@ -40,6 +40,11 @@ One run = one JSONL stream of nine event kinds:
   deterministic preemption marker.  Pure function of (campaign seed,
   round_index): ``control.replay`` re-derives the whole campaign from
   the run header's ``campaign_spec``.
+- ``serve``       — one per communication round while the serving plane
+  is on (schema v13; ``serve/``): the seeded traffic draw, the greedy
+  pad-to-bucket batch plan, the hot-swap weights version, and advisory
+  p50/p99/QPS/swap-gap/eval-stream telemetry.  The pure subset
+  re-derives from the run header's ``serve_spec`` + round index alone.
 
 The schema unifies what ``engine.py``, ``cpc_engine.py`` and
 ``vae_engine.py`` used to build as ad-hoc dicts; every record carries
@@ -162,11 +167,29 @@ from typing import Any, Dict
 # re-derives the whole campaign schedule bit-exactly from the header
 # config's campaign_spec alone.  Campaign-off streams carry no
 # `campaign` records and stay byte-identical to v11.
-# v1..v11 records remain valid: validate_record accepts ver <= SCHEMA_VERSION.
-SCHEMA_VERSION = 12
+# v13 (additive): the serving plane (serve/) — a new `serve` record
+# kind, one per communication round while serving is on, emitted right
+# after the campaign record slot in the round fan-out (file order ==
+# replay order).  The record splits into a PURE subset and advisory
+# telemetry.  Pure (re-derived bit-exactly by control.replay from the
+# header config's serve_spec + the round index alone): `weights_version`
+# (1 + round_index // swap_every — forced refreshes republish at the
+# SAME version, keeping the sequence resume-free), `requests` (the
+# seeded diurnal traffic draw, tag 83), `batches`/`padded_slots`/
+# `padding_waste_frac` (the greedy pad-to-bucket plan), `drift_injected`
+# (round_index >= drift_at) and `swap` (round_index % swap_every == 0).
+# Advisory (wall-clock/model-dependent — never replay-checked):
+# `serve_p50_ms`/`serve_p99_ms` request latency, `serve_qps`,
+# `swap_gap_seconds` (double-buffered publish gap), `serve_accuracy`/
+# `drift_score` (the eval-stream loop into obs/health.py's serve_drift
+# rule) and `forced_refresh` (a control-plane serve_swap intervention
+# republished the weights this round).  Serving-off streams carry no
+# `serve` records and stay byte-identical to v12.
+# v1..v12 records remain valid: validate_record accepts ver <= SCHEMA_VERSION.
+SCHEMA_VERSION = 13
 
 EVENTS = ("run_header", "round", "summary", "span", "alert", "compile",
-          "control", "client", "campaign")
+          "control", "client", "campaign", "serve")
 
 
 class SchemaError(ValueError):
@@ -208,7 +231,7 @@ FIELDS: Dict[str, Any] = {
     # round coordinates (spans and alerts are keyed to the same index the
     # XProf round_trace annotations use, so all three timelines correlate)
     "round_index":  (("round", "span", "alert", "compile", "control",
-                      "client", "campaign"), _INT),
+                      "client", "campaign", "serve"), _INT),
     "nloop":        (("round",), _INT),
     "block":        (("round",), _INT),
     "nadmm":        (("round",), _INT),
@@ -354,6 +377,24 @@ FIELDS: Dict[str, Any] = {
     "burst":        (("campaign",), _BOOL),    # seeded tag-79 event live
     "preempt_now":  (("campaign",), _BOOL),    # deterministic preempt_at
     "phase":        (("campaign",), _STR),     # trough|shoulder|peak|...
+    # serving plane (schema v13; serve/).  Pure subset first (re-derived
+    # by control.replay from the header serve_spec + round index), then
+    # the advisory timing/eval telemetry; no time_unix on the record —
+    # wall-clock facts ride ONLY in advisory fields.
+    "weights_version": (("serve",), _INT),     # 1 + ridx // swap_every
+    "requests":     (("serve",), _INT),        # seeded traffic draw (tag 83)
+    "batches":      (("serve",), _INT),        # dispatched micro-batches
+    "padded_slots": (("serve",), _INT),        # bucket slots left empty
+    "padding_waste_frac": (("serve",), _NUM),  # padded / total slots
+    "drift_injected": (("serve",), _BOOL),     # ridx >= drift_at
+    "swap":         (("serve",), _BOOL),       # ridx % swap_every == 0
+    "serve_p50_ms": (("serve",), _NUM),        # advisory from here down
+    "serve_p99_ms": (("serve",), _NUM),
+    "serve_qps":    (("serve",), _NUM),
+    "swap_gap_seconds": (("serve",), _NUM),    # double-buffer publish gap
+    "serve_accuracy": (("serve",), _NUM),      # eval-stream live accuracy
+    "drift_score":  (("serve",), _NUM),        # 1 - acc/EMA, floored at 0
+    "forced_refresh": (("serve",), _BOOL),     # control-plane republish
     # summary totals / rates
     "status":       (("summary",), _STR),
     "rounds":       (("summary",), _INT),
@@ -400,6 +441,8 @@ REQUIRED = {
     "client": ("event", "schema", "run_id", "round_index", "clients"),
     "campaign": ("event", "schema", "run_id", "round_index",
                  "virtual_seconds"),
+    "serve": ("event", "schema", "run_id", "round_index",
+              "weights_version", "requests"),
 }
 
 

@@ -1,0 +1,39 @@
+"""Serving plane: batched online inference over the consensus model.
+
+The training loop (train/rounds.py) produces a consensus state z every
+round; this package turns it into something that answers requests:
+
+- ``infer``    pad-to-bucket jit-compiled batched predict per engine
+               (classifier logits, VAE reconstruction score, CPC
+               embedding) — static shapes, bounded retraces.
+- ``batcher``  deterministic request micro-batcher plus the seeded
+               synthetic-traffic grammar (``ServeSchedule``, draw tag
+               83) whose per-round record is a pure function of
+               (seed, round coordinates) so control/replay.py can
+               re-derive it bit-exactly.
+- ``swap``     double-buffered round-boundary weight hot-swap: an
+               in-flight request is answered by exactly the old or the
+               new weights, never a torn mix.
+- ``evalstream`` served traffic doubles as an eval stream whose live
+               accuracy feeds obs/health.py (``serve_drift``) and, in
+               act mode, the control plane — the continuous-learning
+               loop.
+
+Serving is off by default (``cfg.serve_spec == "none"``) and the off
+path is bitwise the seed training path (golden-digest gated).
+"""
+
+from .batcher import (  # noqa: F401
+    SERVE_FIELDS,
+    SERVE_TAG,
+    MicroBatcher,
+    ServeSchedule,
+)
+from .evalstream import EvalStream  # noqa: F401
+from .infer import (  # noqa: F401
+    BatchedPredictor,
+    bucket_for,
+    consensus_weights,
+    pad_to_bucket,
+)
+from .swap import DoubleBuffer, version_for  # noqa: F401

@@ -1,0 +1,201 @@
+"""Batched predict over the consensus state — jit once, serve any size.
+
+The export path: training holds per-client stacked state ``[K, ...]``;
+``consensus_weights`` collapses it to the single served model (the
+plain tree-mean consensus z, matching the server average the round
+kernel converges to).  ``BatchedPredictor`` wraps an engine head in ONE
+``jax.jit`` and only ever calls it at the configured pad-bucket shapes,
+so the number of compiled programs is bounded by ``len(buckets)`` —
+serving never retraces per request size, no matter what the traffic
+draw produces.
+
+Heads are engine-shaped post-processors over an injected forward
+callable (classifier → logits, VAE → per-sample reconstruction score,
+CPC → flattened embedding), so they unit-test with toy callables and
+attach to any engine's ``model.apply`` without this module importing
+engine code.  Weights are NOT donated — serving is a read, the trainer
+keeps using the same consensus state (same rule as the engine eval
+path), and the hot-swap buffer may hand the identical tree to many
+batches.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= n; the largest bucket when none fits (the
+    micro-batcher splits oversize groups before padding)."""
+    for b in buckets:
+        if n <= b:
+            return int(b)
+    return int(buckets[-1])
+
+
+def pad_to_bucket(x: np.ndarray, bucket: int) -> np.ndarray:
+    """Pad ``x`` along axis 0 to ``bucket`` rows by repeating row 0 —
+    real sample content, so the padded batch is always valid model
+    input.  Pad rows are sliced off the output, never scored."""
+    x = np.asarray(x)
+    n = x.shape[0]
+    if n == bucket:
+        return x
+    if n > bucket:
+        raise ValueError(f"batch of {n} does not fit bucket {bucket}")
+    return np.concatenate([x, np.repeat(x[:1], bucket - n, axis=0)], axis=0)
+
+
+def consensus_weights(stacked_tree: Any) -> Any:
+    """Mean over the leading per-client axis of every leaf: the served
+    consensus z.  Dtype-preserving so integer leaves (e.g. BN counters)
+    survive the averaging."""
+    import jax
+    import jax.numpy as jnp
+
+    def mean0(a):
+        return jnp.mean(a, axis=0, dtype=jnp.float32).astype(a.dtype)
+
+    return jax.tree_util.tree_map(mean0, stacked_tree)
+
+
+# ----------------------------------------------------------------------
+# engine heads: forward(weights, x) -> engine-shaped per-request output
+# ----------------------------------------------------------------------
+def classifier_head(forward: Callable[[Any, Any], Any]):
+    """Logits passthrough ([n, n_classes])."""
+    def raw_fn(weights, x):
+        return forward(weights, x)
+    return raw_fn
+
+
+def vae_head(forward: Callable[[Any, Any], Any]):
+    """Per-sample reconstruction score: ``-mean((recon - x)^2)`` per
+    row, higher is better.  Accepts models returning the reconstruction
+    alone or a (recon, ...) tuple (recon first, e.g. (recon, mu,
+    logvar))."""
+    import jax.numpy as jnp
+
+    def raw_fn(weights, x):
+        out = forward(weights, x)
+        recon = out[0] if isinstance(out, (tuple, list)) else out
+        err = (recon.reshape(x.shape[0], -1)
+               - x.reshape(x.shape[0], -1).astype(recon.dtype)) ** 2
+        return -jnp.mean(err, axis=-1)
+    return raw_fn
+
+
+def cpc_head(forward: Callable[[Any, Any], Any]):
+    """Flattened embedding ([n, d]).  Accepts models returning the
+    embedding alone or an (embedding, ...) tuple."""
+    def raw_fn(weights, x):
+        out = forward(weights, x)
+        emb = out[0] if isinstance(out, (tuple, list)) else out
+        return emb.reshape(x.shape[0], -1)
+    return raw_fn
+
+
+HEADS = {
+    "classifier": classifier_head,
+    "vae": vae_head,
+    "cpc": cpc_head,
+}
+
+
+class BatchedPredictor:
+    """One jit, bucketed shapes, any request-batch size.
+
+    ``raw_fn(weights, x)`` is an engine head; ``buckets`` the ascending
+    pad sizes from the ``ServeSchedule``.  ``stage`` (optional) places
+    the padded host batch before dispatch (e.g. the engine's replicated
+    / data-sharded ``device_put``) — identity when serving off-mesh.
+    ``jit=False`` keeps the head un-jitted for pure-host unit tests.
+    """
+
+    def __init__(self, raw_fn: Callable[[Any, Any], Any],
+                 buckets: Sequence[int],
+                 stage: Optional[Callable[[np.ndarray], Any]] = None,
+                 jit: bool = True):
+        self.buckets = tuple(int(b) for b in buckets)
+        self.stage = stage
+        if jit:
+            import jax
+            # no donation: serving is a read — the trainer and the swap
+            # buffer keep using the same weights tree across batches
+            self._fn = jax.jit(raw_fn)  # graftlint: disable=JG106
+        else:
+            self._fn = raw_fn
+        self.dispatches = 0
+        self.shapes_seen: set = set()
+
+    def __call__(self, weights: Any, x: np.ndarray) -> np.ndarray:
+        """Answer a request batch of any size <= max bucket: pad to
+        bucket, dispatch at a static shape, slice the pad rows off."""
+        x = np.asarray(x)
+        n = x.shape[0]
+        bucket = bucket_for(n, self.buckets)
+        if n > bucket:
+            raise ValueError(
+                f"request batch of {n} exceeds max bucket {bucket}")
+        xp = pad_to_bucket(x, bucket)
+        self.shapes_seen.add(xp.shape)
+        if self.stage is not None:
+            xp = self.stage(xp)
+        out = self._fn(weights, xp)
+        self.dispatches += 1
+        return np.asarray(out)[:n]
+
+
+def selftest() -> str:
+    buckets = (4, 16, 64)
+    assert bucket_for(3, buckets) == 4
+    assert bucket_for(4, buckets) == 4
+    assert bucket_for(5, buckets) == 16
+    assert bucket_for(999, buckets) == 64
+    x = np.arange(6, dtype=np.float32).reshape(3, 2)
+    xp = pad_to_bucket(x, 4)
+    assert xp.shape == (4, 2) and np.array_equal(xp[3], x[0])
+    assert pad_to_bucket(x, 3) is x
+
+    # toy heads, no jit: pure-host shape/value checks
+    w = {"scale": np.float32(2.0)}
+
+    def fwd_logits(weights, xb):
+        return xb * weights["scale"]
+
+    pred = BatchedPredictor(classifier_head(fwd_logits), buckets, jit=False)
+    out = pred(w, x)
+    assert out.shape == (3, 2) and np.allclose(out, x * 2.0)
+    # bucketed dispatch: 3 rows and 4 rows share one padded shape
+    pred(w, np.ones((4, 2), np.float32))
+    assert pred.shapes_seen == {(4, 2)} and pred.dispatches == 2
+
+    def fwd_vae(weights, xb):
+        return (xb, None, None)  # perfect reconstruction -> score 0
+
+    vae = BatchedPredictor(vae_head(fwd_vae), buckets, jit=False)
+    import jax.numpy as jnp  # vae_head computes with jnp
+    scores = vae(w, jnp.asarray(x))
+    assert scores.shape == (3,) and np.allclose(scores, 0.0)
+
+    def fwd_cpc(weights, xb):
+        return xb.reshape(xb.shape[0], 1, -1)
+
+    cpc = BatchedPredictor(cpc_head(fwd_cpc), buckets, jit=False)
+    emb = cpc(w, x)
+    assert emb.shape == (3, 2)
+
+    # consensus: mean over the client axis, dtype preserved
+    stacked = {"p": np.stack([np.zeros((2,), np.float32),
+                              np.full((2,), 2.0, np.float32)]),
+               "n": np.asarray([2, 4], np.int32)}
+    z = consensus_weights(stacked)
+    assert np.allclose(np.asarray(z["p"]), 1.0)
+    assert np.asarray(z["n"]).dtype == np.int32
+    return "serve.infer selftest: OK"
+
+
+if __name__ == "__main__":
+    print(selftest())

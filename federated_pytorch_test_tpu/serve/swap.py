@@ -1,0 +1,138 @@
+"""Double-buffered round-boundary weight hot-swap.
+
+Training and serving share one process (and, on real hardware, one
+mesh); the swap is how a freshly-trained consensus state reaches the
+request path without a restart.  Two invariants:
+
+- **Never torn.**  ``publish`` installs ``(version, weights)`` with a
+  single attribute assignment — atomic under the GIL — and ``acquire``
+  returns the whole tuple, so a request in flight during a swap is
+  answered by exactly the old or exactly the new weights.  There is no
+  window where a batch sees version N's classifier head on version
+  N+1's trunk.
+- **Replayable.**  *Which* version serves round r is not decided here:
+  it is ``ServeSchedule.weights_version(r) = 1 + r // swap_every``, a
+  pure function of the round index, so kill/resume and
+  ``control/replay.py`` re-derive the swap sequence with zero serve
+  state in the checkpoint.  This module only carries the payload and
+  times the gap.
+
+``swap_gap_seconds`` (publish wall time, including an optional
+``block_until_ready`` on the incoming weights) is advisory telemetry —
+recorded and benched, never replay-checked.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional, Tuple
+
+
+def version_for(round_index: int, swap_every: int) -> int:
+    """Weights version serving round ``round_index`` (pure)."""
+    return 1 + round_index // swap_every
+
+
+class DoubleBuffer:
+    """Holds the served weights; swap by atomic reference replacement."""
+
+    def __init__(self) -> None:
+        self._active: Optional[Tuple[int, Any]] = None
+        # serializes concurrent publishers (and their swap/gap counter
+        # updates); readers stay lock-free — acquire() snapshots the one
+        # atomically-assigned tuple
+        self._lock = threading.Lock()
+        self.swaps = 0
+        self.last_gap_seconds = 0.0
+
+    def publish(self, version: int, weights: Any,
+                block: bool = False) -> float:
+        """Install ``weights`` as version ``version``; returns the swap
+        gap in seconds.  ``block=True`` waits for the incoming arrays to
+        be ready on device first, so the gap covers transfer, not just
+        the pointer flip.  Re-publishing the current version (a forced
+        refresh from the control plane) is allowed and counts as a swap
+        in the gap telemetry but does not bump the version."""
+        t0 = time.perf_counter()
+        if block:
+            try:
+                import jax
+                jax.block_until_ready(weights)
+            except Exception:  # host-only weights: nothing to wait for
+                pass
+        with self._lock:
+            # the swap itself: one attribute assignment, atomic under
+            # the GIL, so a lock-free acquire() never sees a torn pair
+            self._active = (int(version), weights)
+            gap = time.perf_counter() - t0
+            self.swaps += 1
+            self.last_gap_seconds = gap
+        return gap
+
+    def acquire(self) -> Tuple[int, Any]:
+        """Snapshot ``(version, weights)`` for one request batch.  The
+        caller keeps using the returned tuple even if a publish lands
+        mid-batch — that is the never-torn contract."""
+        active = self._active
+        if active is None:
+            raise RuntimeError("DoubleBuffer.acquire before first publish")
+        return active
+
+    @property
+    def version(self) -> int:
+        active = self._active
+        return -1 if active is None else active[0]
+
+
+def selftest() -> str:
+    import threading
+
+    buf = DoubleBuffer()
+    assert buf.version == -1
+    try:
+        buf.acquire()
+    except RuntimeError:
+        pass
+    else:
+        raise AssertionError("acquire before publish should raise")
+    gap = buf.publish(1, {"w": 1.0})
+    assert gap >= 0.0 and buf.version == 1 and buf.swaps == 1
+    assert version_for(0, 2) == 1 and version_for(5, 2) == 3
+
+    # hammer publish from a writer thread while readers acquire: every
+    # snapshot must be internally consistent (version matches payload)
+    stop = threading.Event()
+    errors = []
+
+    def writer() -> None:
+        v = 2
+        while not stop.is_set():
+            buf.publish(v, {"w": float(v)})
+            v += 1
+
+    def reader() -> None:
+        for _ in range(20000):
+            version, weights = buf.acquire()
+            if weights["w"] != float(version):
+                errors.append((version, weights))
+                return
+
+    w = threading.Thread(target=writer)
+    readers = []
+    for _ in range(4):
+        r = threading.Thread(target=reader)
+        readers.append(r)
+    w.start()
+    for r in readers:
+        r.start()
+    for r in readers:
+        r.join()
+    stop.set()
+    w.join()
+    assert not errors, f"torn read: {errors[:3]}"
+    return "serve.swap selftest: OK"
+
+
+if __name__ == "__main__":
+    print(selftest())

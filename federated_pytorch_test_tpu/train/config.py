@@ -131,6 +131,25 @@ class FederatedConfig:
     # any recorded value (PARITY.md v0.13).
     campaign_accel: float = 0.0
 
+    # serving plane (serve/): batched online inference over the
+    # consensus state, ridden at every round boundary — the consensus
+    # weights hot-swap into a double-buffered predictor (never torn:
+    # each request batch is answered by exactly one weights version),
+    # seeded synthetic traffic (draw tag 83, campaign-style diurnal
+    # wave) flows through a pad-to-bucket micro-batcher, and the served
+    # answers double as an eval stream feeding the serve_drift health
+    # rule and (act mode) the control plane's refresh_serving rung.
+    # Every planning field of the additive `serve` record (schema v13)
+    # — requests, batch plan, weights_version = 1 + round // swap_every,
+    # drift injection — is a pure function of (seed, round_index), so
+    # control.replay re-derives it from the header config and no serve
+    # state rides in checkpoints; latency/QPS/swap-gap/accuracy are
+    # advisory.  "none" = serving off, the literal seed path (bitwise —
+    # golden-digest gated).  Grammar:
+    #   qps=N,round_minutes=M,diurnal=A,buckets=8+32+128,swap_every=N,
+    #   drift_at=R,seed=N
+    serve_spec: str = "none"
+
     # elastic federation (mesh-reshaping resume): allow a checkpoint
     # written on a D-device mesh to restore onto a D'-device mesh — the
     # [K, ...] client stack restages onto the surviving mesh (K % D' must

@@ -999,6 +999,66 @@ class BlockwiseFederatedTrainer(RoundKernel):
                     self.test_x, self.test_y, self.test_w)
         return self.eval_finalize(fetch(totals), self.test_n)
 
+    def _serve_export(self, state: ClientState):
+        """The served consensus (serve/, RoundKernel._serve_tick): the
+        tree-mean over the [K] client stack of (params, batch_stats) —
+        the plain average the consensus z converges to.  A read, not a
+        donation (same rule as _build_eval): the trainer keeps using
+        ``state`` after every export."""
+        from federated_pytorch_test_tpu.serve.infer import consensus_weights
+        return consensus_weights((state.params, state.batch_stats))
+
+    def _build_serve_plane(self, sched) -> dict:
+        """Serving runtime for the classifier-shaped engines (serve/):
+        the engine head wrapped in a bucketed jitted predictor, the
+        double-buffered hot-swap, the micro-batcher, and a host traffic
+        pool drawn from the real test set (wrap-padded rows weighted
+        out).  The classifier engine also gets the eval stream —
+        served answers scored live against the requests' labels
+        (serve/evalstream.py, the serve_drift feed)."""
+        from federated_pytorch_test_tpu.serve.batcher import MicroBatcher
+        from federated_pytorch_test_tpu.serve.evalstream import EvalStream
+        from federated_pytorch_test_tpu.serve.infer import (
+            HEADS,
+            BatchedPredictor,
+        )
+        from federated_pytorch_test_tpu.serve.swap import DoubleBuffer
+
+        # serving normalization: the consensus model reads the MEAN of
+        # the per-client train norm stats (serving is an advisory path —
+        # the training math never sees this array)
+        norm = np.asarray(self._client_norm_host.mean(axis=0), np.float32)
+
+        def forward(weights, xb_u8):
+            p, bs = weights
+            xb = _normalize_u8(xb_u8, norm)
+            if self.has_bn:
+                return self.model.apply(
+                    {"params": p, "batch_stats": bs}, xb, train=False)
+            return self.model.apply({"params": p}, xb, train=False)
+
+        head_key = ("vae" if self.obs_engine.startswith("vae")
+                    else "cpc" if self.obs_engine == "cpc"
+                    else "classifier")
+        pred = BatchedPredictor(HEADS[head_key](forward), sched.buckets)
+        plane: dict = {"buffer": DoubleBuffer(), "pred": pred}
+        # the dispatch closure reads the tick's acquired snapshot
+        # (plane["current"]) — one weights version per drained round
+        plane["batcher"] = MicroBatcher(
+            sched, lambda batch: pred(plane["current"], batch),
+            max_queue=1 << 20)
+        xt = np.asarray(fetch(self.test_x))
+        yt = np.asarray(fetch(self.test_y))
+        wt = np.asarray(fetch(self.test_w))
+        keep = wt.reshape(-1) > 0
+        plane["pool_x"] = xt.reshape((-1,) + xt.shape[2:])[keep]
+        plane["pool_y"] = yt.reshape(-1)[keep]
+        plane["pool_n"] = int(plane["pool_x"].shape[0])
+        plane["stream"] = (
+            EvalStream(sched, window=self.cfg.health_window)
+            if head_key == "classifier" else None)
+        return plane
+
     def _epoch_seed(self, counter: int, stream: int) -> int:
         """Deterministic seed keyed on (config seed, epoch counter, stream).
 

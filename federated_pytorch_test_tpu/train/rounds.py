@@ -103,6 +103,24 @@ class RoundKernel:
         self._campaign_floor = -1
         self._campaign_window = None
         self._campaign_last_hour = None
+        # serving plane (serve/): batched online inference over the
+        # consensus state at every round boundary.  The parsed schedule
+        # owns the PURE per-round plan — traffic draw (tag 83), batch
+        # plan, weights_version = 1 + r // swap_every, drift injection —
+        # all functions of (seed, round_index) alone, so control.replay
+        # re-derives every pure `serve` field from the header config and
+        # NO serve state rides in the checkpoint meta (a resumed segment
+        # republishes the round's version on its first tick).  None =
+        # serving off, the literal seed path (bitwise; golden-gated).
+        # The runtime half (predictor, hot-swap buffer, eval stream) is
+        # built lazily at the first serving round via the engine's
+        # _build_serve_plane hook; _serve_forced is the control plane's
+        # pending forced-refresh flag (serve_swap interventions).
+        from federated_pytorch_test_tpu.serve.batcher import ServeSchedule
+        self._serve_sched = ServeSchedule.parse(
+            getattr(cfg, "serve_spec", "none"))
+        self._serve_plane = None
+        self._serve_forced = False
         self.mean_fn = make_robust_mean(cfg.robust_agg,
                                         trim_frac=cfg.trim_frac,
                                         clip_mult=cfg.clip_mult)
@@ -590,6 +608,80 @@ class RoundKernel:
             obs.campaign_event(self.campaign.record_fields(w))
         self._campaign_last_hour = w.hour
 
+    # ------------------------------------------------------------------
+    # serving plane (serve/): hot-swap + traffic + the `serve` record
+    # ------------------------------------------------------------------
+    def _build_serve_plane(self, sched) -> dict:
+        """Engine hook: build the serving runtime for this engine — a
+        dict with the bucketed jitted predictor, the hot-swap buffer,
+        the micro-batcher, the host traffic pool and (classifier-shaped
+        engines) the eval stream.  The base kernel has no model surface
+        to serve; engines that do (train/engine.py) override."""
+        raise ValueError(
+            f"serve_spec is set but the {self.obs_engine!r} engine has "
+            "no serving adapter (_build_serve_plane); serve with the "
+            "classifier/VAE engines, or use serve.infer heads directly")
+
+    def _serve_export(self, state):
+        """Engine hook: the served consensus weights for the current
+        client state (overridden next to ``_build_serve_plane``)."""
+        raise ValueError(
+            f"the {self.obs_engine!r} engine has no serving adapter")
+
+    def _serve_tick(self, obs, round_index: int, state, log=print) -> None:
+        """One serving round, ridden at the round-obs boundary.
+
+        Order of operations: publish (when the schedule's pure swap
+        sequence says this round starts a new ``weights_version``, or a
+        control-plane forced refresh is pending), then answer the
+        round's seeded traffic through the micro-batcher, then score the
+        answers on the eval stream and emit ONE additive ``serve``
+        record (schema v13).  The pure fields all come from the
+        schedule; latency/gap/accuracy numbers are advisory.  A forced
+        refresh republishes the CURRENT consensus without bumping the
+        version, so interventions never perturb the replay-checked swap
+        sequence."""
+        sched = self._serve_sched
+        forced = self._serve_forced
+        self._serve_forced = False
+        if self._serve_plane is None:
+            self._serve_plane = self._build_serve_plane(sched)
+        plane = self._serve_plane
+        fields = sched.record_fields(round_index)
+        version = int(fields["weights_version"])
+        gap = None
+        if plane["buffer"].version != version or forced:
+            gap = plane["buffer"].publish(version, self._serve_export(state),
+                                          block=True)
+        # request content: pool rows drawn on the tag-83 content
+        # substream — deterministic, but advisory (replay checks the
+        # COUNT, which is the schedule's requests_for draw)
+        n = int(fields["requests"])
+        rng = np.random.default_rng([sched.seed, 83, round_index, 2])
+        idx = rng.integers(plane["pool_n"], size=n)
+        _, served = plane["buffer"].acquire()
+        plane["current"] = served       # snapshot for the whole drain:
+        mb = plane["batcher"]           # never-torn even if a publish
+        pool_x = plane["pool_x"]        # landed mid-round
+        for i in idx:
+            mb.submit(pool_x[i])
+        outs, tel = mb.drain()
+        rec = dict(fields)
+        rec["serve_p50_ms"] = round(tel["serve_p50_ms"], 6)
+        rec["serve_p99_ms"] = round(tel["serve_p99_ms"], 6)
+        rec["serve_qps"] = round(tel["serve_qps"], 6)
+        if gap is not None:
+            rec["swap_gap_seconds"] = round(gap, 6)
+        if forced:
+            rec["forced_refresh"] = True
+            log(f"serve: forced refresh applied at round {round_index} "
+                f"(version {version} republished)")
+        stream = plane.get("stream")
+        if stream is not None and plane.get("pool_y") is not None:
+            rec.update(stream.score(round_index, np.stack(outs),
+                                    plane["pool_y"][idx]))
+        obs.serve_event(rec)
+
     def _maybe_preempt(self, nloop: int, ci: int, nadmm: int,
                        rounds_done: int, checkpoint_path) -> None:
         """Simulated slice preemption (fault family ``preempt=``).
@@ -1004,6 +1096,11 @@ class RoundKernel:
             # the campaign window transition, if any, rides right behind
             # the round record too (schema v12)
             self._emit_campaign_record(obs, round_index)
+        if self._serve_sched is not None and state is not None:
+            # the serving tick rides the round boundary: hot-swap at the
+            # schedule's cadence, answer this round's seeded traffic,
+            # emit the additive `serve` record (schema v13)
+            self._serve_tick(obs, round_index, state, log=log)
         if obs.enabled:
             rspan = (rrec or {}).get("span_id")
             for nm, cat, s0, s1 in phase_marks:
@@ -1115,6 +1212,18 @@ class RoundKernel:
                 self._cohort_frac = float(d.to_value)
                 log(f"control: {d.intervention} cohort_frac "
                     f"{old_f} -> {self._cohort_frac} ({d.reason})")
+            elif d.param == "serve_swap":
+                # serve-drift rung: arm a forced refresh — the NEXT
+                # round's serve tick republishes the current consensus
+                # WITHOUT bumping weights_version, so the pure swap
+                # sequence control.replay re-derives is untouched
+                if self._serve_sched is None:
+                    log("control: skip serve_swap (serving is off for "
+                        "this run)")
+                    continue
+                self._serve_forced = True
+                log(f"control: {d.intervention} armed a forced serving "
+                    f"refresh ({d.reason})")
         d = ctl.take_restart()
         if d is not None:
             from federated_pytorch_test_tpu.control.policy import (

@@ -872,6 +872,12 @@ class TestEngineAwareLadder:
 
 
 class TestCPCSupervised:
+    # ~76 s: the single slowest tier-1 case (two full supervised CPC
+    # runs).  Supervised crash/resume stays fast-covered by
+    # TestSupervisedVsManualResume and TestChaosAcceptance above; the
+    # CPC-engine resume contract by TestCPCGolden's default path +
+    # tests/test_faults.py's CPC representatives.
+    @pytest.mark.slow
     def test_crash_resume_matches_uninterrupted(self, tmp_path):
         """Supervised CPC (bare ``supervise`` + ladder_records describe,
         the drivers/federated_cpc path): one injected crash, restart 1

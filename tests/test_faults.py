@@ -881,6 +881,10 @@ class TestVAEKernelParity:
                                async_rounds=True, max_staleness=4)
         return hist[-1]["loss"]
 
+    # slow: the clean-baseline fixture plus two aggregator runs cost
+    # ~2 minutes of VAE training; test_quarantine_cadence above keeps a
+    # fast VAE-kernel representative in the tier-1 run
+    @pytest.mark.slow
     @pytest.mark.parametrize("agg,frac", [("median", 0.2), ("krum", 0.4)])
     def test_byzantine_nan_tracks_clean_baseline(self, data8,
                                                  clean_vae_loss, agg, frac):
@@ -963,6 +967,11 @@ class TestCPCKernelParity:
         assert clients_main([path, "--expect-top", "0"]) == 2
         capsys.readouterr()
 
+    # slow: three full CPC runs (uninterrupted, killed, resumed) cost
+    # ~100 s; the cpc_chaos fixture trio above keeps the fast CPC-kernel
+    # representatives in the tier-1 run, and tests/test_serve.py's
+    # kill/resume case covers the checkpoint path every tier-1 run
+    @pytest.mark.slow
     def test_async_kill_resume_ledger_exact(self, tmp_path):
         # --async-rounds with delay stragglers, guard + quarantine and a
         # median aggregator: interrupting mid-block and resuming must

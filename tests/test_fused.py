@@ -23,6 +23,11 @@ second check's program is an in-process compile-cache hit), and checks
 a crash prevented from running are retried in a fresh child.
 ``FEDTPU_FUSED_CHECK=<name,...|all> python tests/test_fused.py`` is the
 child entry point.
+
+The same native bug can also corrupt the donated buffers *silently*
+(observed here as ~1e-4 param drift instead of a crash), so a
+Python-level child failure is retried once in a fresh child before it
+is trusted: deterministic regressions reproduce, corruption does not.
 """
 
 import os
@@ -299,6 +304,14 @@ _CHILD_CHECKS = {"kill_resume": _check_kill_resume,
 # swallow the other check's coverage
 _CHILD_VERDICTS = {}  # check -> ("ok", None) | ("skip", sig) | ("fail", proc)
 
+# the native UB that usually aborts (module docstring) can instead
+# corrupt the donated buffers SILENTLY — observed on this box as ~1e-4
+# param drift failing the otherwise-bitwise comparison.  A real
+# regression reproduces in a fresh child; one-off corruption does not —
+# so a Python-level failure gets exactly one fresh-child retry before
+# its verdict is trusted
+_RETRIED = set()
+
 
 def _spawn_checks(checks):
     env = dict(os.environ, FEDTPU_FUSED_CHECK=",".join(checks),
@@ -318,15 +331,21 @@ def _spawn_checks(checks):
         _CHILD_VERDICTS[remaining.pop(0)] = ("ok", None)
     if not remaining:
         return
+    first, rest = remaining[0], remaining[1:]
     if proc.returncode < 0:
         # the first unfinished check crashed natively; the ones after it
         # never ran — give them their own child
-        _CHILD_VERDICTS[remaining[0]] = ("skip", -proc.returncode)
-        if remaining[1:]:
-            _spawn_checks(remaining[1:])
+        _CHILD_VERDICTS[first] = ("skip", -proc.returncode)
+    elif first not in _RETRIED:
+        # Python-level failure: possibly silent native corruption
+        # (_RETRIED docstring) — retry this one check in a fresh child;
+        # a deterministic regression will fail again and be recorded
+        _RETRIED.add(first)
+        _spawn_checks([first])
     else:
-        for c in remaining:
-            _CHILD_VERDICTS[c] = ("fail", proc)
+        _CHILD_VERDICTS[first] = ("fail", proc)
+    if rest:
+        _spawn_checks(rest)
 
 
 def _run_isolated(check: str) -> None:

@@ -224,6 +224,11 @@ class TestCPCGolden:
                                        log=lambda m: None)
         _check("cpc_admm", _digest(hist, state))
 
+    # ~30 s (two CPC runs): the CPC trajectory itself stays pinned by
+    # test_default_path above; classifier + VAE keep their fast golden
+    # kill/resume cases, and the one-round-kernel refactor means the
+    # checkpoint path under test is engine-shared
+    @pytest.mark.slow
     def test_kill_resume_matches_uninterrupted(self, tmp_path):
         """Stop after 3 rounds (mid-block) via the log callback, resume
         in a fresh trainer: combined history must equal the golden."""

@@ -81,6 +81,10 @@ class TestBatchModeLineSearch:
         assert float(rosen(x)) < f0 * 0.2
         assert np.all(np.isfinite(np.asarray(x)))
 
+    # ~23 s of line-search iterations; the batch-changed/alphabar path
+    # keeps a fast representative in test_history_eviction and the
+    # full-batch Wolfe cases
+    @pytest.mark.slow
     def test_stochastic_least_squares(self):
         # different minibatch objective per step: the batch-changed path and
         # alphabar machinery must keep the trajectory stable
