@@ -66,14 +66,17 @@ from .rules import (FunctionNode, MODULE_RULES, _donate_ints, _dotted,
                     _fn_param_names)
 
 #: bump when the summary shape changes; stale cache entries re-extract
-SUMMARY_VERSION = 2
+#: (v3 added the determinism-contract facts consumed by JG117-JG121:
+#: entropy sources, dict-field stores/loads, recorder emit sites, rng
+#: constructions, key derivations, unordered iteration, literal tables)
+SUMMARY_VERSION = 3
 
 #: bump whenever extraction *logic* or any rule changes behaviour without
 #: changing the summary shape — ``lint --cache`` folds this into its
 #: cache-validity check, so a rule edit invalidates sha1-matched entries
 #: that would otherwise serve stale summaries (the shape-only
 #: SUMMARY_VERSION cannot catch logic changes)
-ANALYSIS_VERSION = 2
+ANALYSIS_VERSION = 3
 
 #: callable wrappers that pass their first argument's signature through
 _TRANSPARENT_WRAPPERS = {"vmap", "pmap", "jit", "pjit", "shard_map",
@@ -99,6 +102,365 @@ _SYNC_MAKERS = {
     "Thread": "thread",
     "ThreadPoolExecutor": "pool", "ProcessPoolExecutor": "pool",
 }
+
+#: canonical dotted calls that read wall-clock or OS entropy (JG117);
+#: call heads are resolved through the module's import aliases first, so
+#: ``from time import time`` and ``import time`` both land on
+#: ``time.time``
+_ENTROPY_CALLS = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "time.gmtime", "time.localtime",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+    "os.urandom", "os.getrandom",
+    "uuid.uuid1", "uuid.uuid3", "uuid.uuid4", "uuid.uuid5",
+    "secrets.token_bytes", "secrets.token_hex", "secrets.token_urlsafe",
+    "secrets.randbits", "secrets.randbelow", "secrets.choice",
+}
+
+#: modules whose bare draws consume the process-global — effectively
+#: unseeded — generator: ``random.random()``, ``np.random.rand()``
+_GLOBAL_RNG_MODULES = {"random", "numpy.random"}
+
+#: attribute calls on those modules that are NOT entropy draws —
+#: constructors (JG121's ``rng_ctors`` fact instead) and state plumbing
+_RNG_NEUTRAL = {"Random", "RandomState", "default_rng", "Generator",
+                "seed", "getstate", "setstate", "PRNGKey"}
+
+#: canonical seeded-generator constructors (JG121 lineage roots)
+_RNG_CTOR_CALLS = {"jax.random.PRNGKey", "jax.random.key",
+                   "numpy.random.default_rng", "numpy.random.RandomState",
+                   "random.Random"}
+
+#: recorder methods whose argument is a record's field payload; values
+#: are the schema record kind each method emits
+_RECORDER_METHODS = {"round": "round", "span": "span", "alert": "alert",
+                     "control_event": "control", "client_event": "client",
+                     "campaign_event": "campaign", "serve_event": "serve",
+                     "compile_event": "compile"}
+
+#: module-level literal tables the contract rules (JG117-JG121) consume;
+#: extracted with ``ast.literal_eval`` so the rules never import linted
+#: code — the tables must therefore stay pure literals at their source
+CONTRACT_TABLE_NAMES = (
+    "ADVISORY_FIELDS", "ENVELOPE_FIELDS", "VERSION_LADDER",
+    "RESERVED_META_NAMESPACES", "DIAGNOSTIC_KINDS",
+    "REPLAY_CHECKERS", "REPLAY_EXEMPT_KINDS",
+    "SCHEMA_VERSION", "EVENTS", "REQUIRED")
+
+
+def _canon_call(d: str, import_mods: Dict[str, str],
+                import_syms: Dict[str, List[str]]) -> str:
+    """Canonical dotted name of a call through the module's imports."""
+    head, _, rest = d.partition(".")
+    sym = import_syms.get(head)
+    if sym is not None:
+        full = (sym[0] + "." + sym[1]) if sym[0] else sym[1]
+    else:
+        full = import_mods.get(head, head)
+    return full + ("." + rest) if rest else full
+
+
+def _entropy_label(canon: str) -> Optional[str]:
+    """The canonical name if ``canon`` is an entropy source, else None."""
+    if canon in _ENTROPY_CALLS:
+        return canon
+    head, _, tail = canon.rpartition(".")
+    if head in _GLOBAL_RNG_MODULES and tail not in _RNG_NEUTRAL:
+        return canon
+    return None
+
+
+def _entropy_in(node: ast.AST, import_mods, import_syms) -> List[str]:
+    """Canonical names of every entropy call anywhere under ``node``."""
+    out: List[str] = []
+    for cur in ast.walk(node):
+        if isinstance(cur, ast.Call):
+            d = _dotted(cur.func)
+            if d:
+                label = _entropy_label(
+                    _canon_call(d, import_mods, import_syms))
+                if label is not None:
+                    out.append(label)
+    return out
+
+
+def _unordered_src(node: ast.AST, known_dicts: Set[str]) -> Optional[str]:
+    """Human label when iterating ``node`` has no deterministic order."""
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return "a set literal"
+    if isinstance(node, ast.Name) and node.id in known_dicts:
+        return "dict %r" % node.id
+    if isinstance(node, ast.Call):
+        d = _dotted(node.func)
+        if d == "set":
+            return "set(...)"
+        if d and "." in d:
+            last = d.rsplit(".", 1)[-1]
+            if last in ("keys", "values", "items"):
+                return d + "()"
+            if last in ("listdir", "scandir", "iterdir", "glob", "iglob"):
+                return d + "()"
+    return None
+
+
+def _assign_names(target: ast.AST) -> List[str]:
+    """Plain names bound by an assignment target (incl. tuple unpack)."""
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for el in target.elts:
+            if isinstance(el, ast.Starred):
+                el = el.value
+            if isinstance(el, ast.Name):
+                out.append(el.id)
+        return out
+    return []
+
+
+def _extract_contracts(fn_node: ast.AST, import_mods: Dict[str, str],
+                       import_syms: Dict[str, List[str]]) -> dict:
+    """Determinism-contract facts for one scope (summary v3).
+
+    Everything here is a *local* observation — which names were assigned
+    entropy, which const-string dict keys were written/read, where the
+    recorder methods were called — stitched into whole-program taint by
+    :mod:`.contracts` (JG117-JG121).  Like the rest of the extractor the
+    pass is purely syntactic: no linted code is ever imported.
+    """
+    entropy: List[list] = []      # [name, canonical source, line]
+    dstores: List[dict] = []      # const-string-key dict writes
+    dloads: List[dict] = []       # const-string-key dict reads
+    dkinds: Dict[str, str] = {}   # dict var -> const "event" value
+    rec_calls: List[dict] = []    # recorder-method emit sites
+    rng_ctors: List[dict] = []    # seeded-generator constructions
+    key_derives: List[dict] = []  # split/fold_in rebindings
+    unordered: List[dict] = []    # iteration with no deterministic order
+    usums: List[dict] = []        # sum()/min()/max() over unordered src
+    ret_esrc: List[str] = []      # entropy calls inside return values
+    ret_loads: List[str] = []     # names loaded by any return value
+
+    known_dicts: Set[str] = set()
+    for node in _walk_scope(fn_node):
+        if (isinstance(node, (ast.Assign, ast.AnnAssign))
+                and isinstance(node.value, ast.Dict)):
+            tgt = (node.targets[0] if isinstance(node, ast.Assign)
+                   and len(node.targets) == 1 else
+                   node.target if isinstance(node, ast.AnnAssign) else None)
+            if isinstance(tgt, ast.Name):
+                known_dicts.add(tgt.id)
+
+    def ent(value: Optional[ast.AST]) -> List[str]:
+        if value is None:
+            return []
+        return _entropy_in(value, import_mods, import_syms)
+
+    def calls_in(value: Optional[ast.AST]) -> List[str]:
+        if value is None:
+            return []
+        out = []
+        for cur in ast.walk(value):
+            if isinstance(cur, ast.Call):
+                d = _dotted(cur.func)
+                if d:
+                    out.append(d)
+        return out
+
+    def store(var: Optional[str], key_node: ast.AST,
+              value: Optional[ast.AST], line: int, col: int) -> None:
+        if not (isinstance(key_node, ast.Constant)
+                and isinstance(key_node.value, str)):
+            return
+        key = key_node.value
+        dstores.append({"var": var, "key": key, "line": line, "col": col,
+                        "loads": _loads_in(value) if value is not None
+                        else [],
+                        "esrc": ent(value), "calls": calls_in(value)})
+        if (var is not None and key == "event"
+                and isinstance(value, ast.Constant)
+                and isinstance(value.value, str)):
+            dkinds[var] = value.value
+
+    def dict_entries(d: ast.Dict, var: Optional[str],
+                     line: int, col: int) -> None:
+        for k, v in zip(d.keys, d.values):
+            if k is not None:
+                store(var, k, v, getattr(v, "lineno", line),
+                      getattr(v, "col_offset", col))
+
+    def comp_unordered(value: ast.AST) -> Optional[str]:
+        if isinstance(value, (ast.ListComp, ast.SetComp,
+                              ast.GeneratorExp, ast.DictComp)):
+            for gen in value.generators:
+                src = _unordered_src(gen.iter, known_dicts)
+                if src:
+                    return src
+        return None
+
+    def call_feeds(call: ast.Call) -> Tuple[List[str], List[str]]:
+        feeds: List[str] = []
+        esrc: List[str] = []
+        for a in list(call.args) + [kw.value for kw in call.keywords]:
+            feeds.extend(_loads_in(a))
+            esrc.extend(ent(a))
+        return feeds, esrc
+
+    def handle_binding(names: List[str], value: ast.AST,
+                       line: int, col: int) -> None:
+        """Classify one ``names = value`` binding."""
+        if isinstance(value, ast.Call):
+            d = _dotted(value.func)
+            if d:
+                canon = _canon_call(d, import_mods, import_syms)
+                parts = canon.split(".")
+                if canon in _RNG_CTOR_CALLS:
+                    feeds, esrc = call_feeds(value)
+                    for n in names:
+                        rng_ctors.append({
+                            "name": n, "ctor": canon, "feeds": feeds,
+                            "esrc": esrc, "line": line, "col": col,
+                            "unseeded": not (value.args or value.keywords)})
+                    return
+                if parts[-1] in ("split", "fold_in") and "random" in parts:
+                    feeds, esrc = call_feeds(value)
+                    for n in names:
+                        key_derives.append({"name": n, "feeds": feeds,
+                                            "esrc": esrc, "line": line})
+                    return
+        # a dict literal does not taint its own name — each entry's
+        # esrc is recorded field-by-field via dict_entries instead, so
+        # an exempt time_unix entry cannot smear siblings
+        if not isinstance(value, ast.Dict):
+            es = ent(value)
+            if es and names:
+                for n in names:
+                    entropy.append([n, es[0], line])
+        src = comp_unordered(value)
+        if src and names:
+            unordered.append({"targets": names, "src": src,
+                              "line": line, "col": col})
+
+    for node in _walk_scope(fn_node):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Name)):
+                    store(t.value.id, t.slice, node.value,
+                          node.lineno, node.col_offset)
+            if (len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Dict)):
+                dict_entries(node.value, node.targets[0].id,
+                             node.lineno, node.col_offset)
+            names: List[str] = []
+            for t in node.targets:
+                names.extend(_assign_names(t))
+            handle_binding(names, node.value, node.lineno,
+                           node.col_offset)
+        elif isinstance(node, ast.AnnAssign):
+            if node.value is None:
+                continue
+            if isinstance(node.target, ast.Name):
+                if isinstance(node.value, ast.Dict):
+                    dict_entries(node.value, node.target.id,
+                                 node.lineno, node.col_offset)
+                handle_binding([node.target.id], node.value,
+                               node.lineno, node.col_offset)
+        elif isinstance(node, ast.AugAssign):
+            if isinstance(node.target, ast.Name):
+                es = ent(node.value)
+                if es:
+                    entropy.append([node.target.id, es[0], node.lineno])
+            elif (isinstance(node.target, ast.Subscript)
+                  and isinstance(node.target.value, ast.Name)):
+                store(node.target.value.id, node.target.slice,
+                      node.value, node.lineno, node.col_offset)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            src = _unordered_src(node.iter, known_dicts)
+            if src:
+                names = _assign_names(node.target)
+                if names:
+                    unordered.append({"targets": names, "src": src,
+                                      "line": node.lineno,
+                                      "col": node.col_offset})
+        elif isinstance(node, ast.Return):
+            if node.value is not None:
+                ret_esrc.extend(ent(node.value))
+                ret_loads.extend(_loads_in(node.value))
+        elif isinstance(node, ast.Subscript):
+            if (isinstance(node.ctx, ast.Load)
+                    and isinstance(node.value, ast.Name)
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)):
+                dloads.append({"var": node.value.id,
+                               "key": node.slice.value,
+                               "line": node.lineno,
+                               "col": node.col_offset, "hard": True})
+        elif isinstance(node, ast.Compare):
+            if (len(node.ops) == 1
+                    and isinstance(node.ops[0], (ast.In, ast.NotIn))
+                    and isinstance(node.left, ast.Constant)
+                    and isinstance(node.left.value, str)
+                    and isinstance(node.comparators[0], ast.Name)):
+                dloads.append({"var": node.comparators[0].id,
+                               "key": node.left.value,
+                               "line": node.lineno,
+                               "col": node.col_offset, "hard": False})
+        elif isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if not d:
+                continue
+            parts = d.split(".")
+            last = parts[-1]
+            base = ".".join(parts[:-1])
+            simple_base = base if base and "." not in base else None
+            if d in ("sum", "min", "max") and node.args:
+                arg = node.args[0]
+                src = comp_unordered(arg) or _unordered_src(arg,
+                                                            known_dicts)
+                if src:
+                    usums.append({"fn": d, "src": src, "line": node.lineno,
+                                  "col": node.col_offset})
+            elif last == "setdefault" and simple_base and node.args:
+                store(simple_base, node.args[0],
+                      node.args[1] if len(node.args) > 1 else None,
+                      node.lineno, node.col_offset)
+            elif (last == "update" and simple_base and node.args
+                  and isinstance(node.args[0], ast.Dict)):
+                dict_entries(node.args[0], simple_base,
+                             node.lineno, node.col_offset)
+            elif (last in ("get", "pop") and simple_base and node.args
+                  and isinstance(node.args[0], ast.Constant)
+                  and isinstance(node.args[0].value, str)):
+                dloads.append({"var": simple_base,
+                               "key": node.args[0].value,
+                               "line": node.lineno,
+                               "col": node.col_offset, "hard": False})
+            elif last in _RECORDER_METHODS and base and node.args:
+                arg = node.args[0]
+                rc = {"m": last, "kind": _RECORDER_METHODS[last],
+                      "line": node.lineno, "col": node.col_offset,
+                      "var": arg.id if isinstance(arg, ast.Name) else None,
+                      "entries": []}
+                if isinstance(arg, ast.Dict):
+                    for k, v in zip(arg.keys, arg.values):
+                        if (k is not None and isinstance(k, ast.Constant)
+                                and isinstance(k.value, str)):
+                            rc["entries"].append(
+                                {"key": k.value,
+                                 "line": getattr(v, "lineno", node.lineno),
+                                 "col": getattr(v, "col_offset", 0),
+                                 "loads": _loads_in(v), "esrc": ent(v),
+                                 "calls": calls_in(v)})
+                rec_calls.append(rc)
+
+    return {"entropy": entropy, "dstores": dstores, "dloads": dloads,
+            "dkinds": dkinds, "rec_calls": rec_calls,
+            "rng_ctors": rng_ctors, "key_derives": key_derives,
+            "unordered": unordered, "usums": usums,
+            "ret_esrc": ret_esrc, "ret_loads": ret_loads}
 
 
 def file_sha1(text: str) -> str:
@@ -813,6 +1175,9 @@ def extract_module_summary(module: ModuleContext) -> dict:
             "joins": walker.joins,
             "globals": walker.globals,
         }
+        if fn_node is not None:
+            functions[qual].update(
+                _extract_contracts(fn_node, import_mods, import_syms))
 
     for node in ast.walk(tree):
         if not isinstance(node, FunctionNode):
@@ -854,6 +1219,28 @@ def extract_module_summary(module: ModuleContext) -> dict:
         "joins": mod_walker.joins,
         "globals": mod_walker.globals,
     }
+    functions["<module>"].update(
+        _extract_contracts(tree, import_mods, import_syms))
+
+    # machine-readable contract tables (ADVISORY_FIELDS, VERSION_LADDER,
+    # REPLAY_CHECKERS, ...): module-level pure-literal assignments only,
+    # so the contract pass reads the declared contract without importing
+    # the code that declares it
+    tables: Dict[str, list] = {}
+    for node in tree.body:
+        tgt = None
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            tgt = node.targets[0].id
+        elif (isinstance(node, ast.AnnAssign)
+              and isinstance(node.target, ast.Name)
+              and node.value is not None):
+            tgt = node.target.id
+        if tgt in CONTRACT_TABLE_NAMES:
+            try:
+                tables[tgt] = [ast.literal_eval(node.value), node.lineno]
+            except (ValueError, SyntaxError, TypeError):
+                pass
 
     summary = {
         "version": SUMMARY_VERSION,
@@ -863,6 +1250,7 @@ def extract_module_summary(module: ModuleContext) -> dict:
         "import_syms": import_syms,
         "jnp_aliases": sorted(index.jnp_aliases),
         "classes": classes,
+        "tables": tables,
         "functions": functions,
         "suppress": [[ln, sorted(ids)] for ln, ids in
                      sorted(suppressed_rules_by_line(module.source).items())],
@@ -1554,10 +1942,12 @@ FLOW_RULES: Tuple[Rule, ...] = (
 )
 
 #: the full shipped rule set: lexical JG101-JG107, flow JG108-JG111,
-#: concurrency JG112-JG116.  threads.py imports Program/summaries from
-#: this module, so the thread rules are pulled in at the bottom — every
-#: name they need is already bound by the time this import runs.
+#: concurrency JG112-JG116, determinism contracts JG117-JG121.
+#: threads.py and contracts.py import Program/summaries from this
+#: module, so their rules are pulled in at the bottom — every name they
+#: need is already bound by the time these imports run.
 from .threads import THREAD_RULES  # noqa: E402  (deliberate late import)
+from .contracts import CONTRACT_RULES  # noqa: E402  (deliberate late)
 
 ALL_RULES: Tuple[Rule, ...] = (tuple(MODULE_RULES) + FLOW_RULES
-                               + THREAD_RULES)
+                               + THREAD_RULES + CONTRACT_RULES)

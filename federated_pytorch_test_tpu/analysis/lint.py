@@ -42,8 +42,13 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m federated_pytorch_test_tpu.analysis.lint",
         description="JAX-aware static analysis for the federated stack")
-    p.add_argument("paths", nargs="+",
+    p.add_argument("paths", nargs="*",
                    help="files or directories (directories recurse to *.py)")
+    p.add_argument("--selftest", action="store_true",
+                   help="run the built-in self-check (each determinism-"
+                        "contract rule fires on its canary snippet and "
+                        "the DEFAULT_TABLES mirror matches the declaring "
+                        "modules) and exit")
     p.add_argument("--json", action="store_true",
                    help="emit findings as JSON instead of text")
     p.add_argument("--sarif", action="store_true",
@@ -166,8 +171,107 @@ def _changed_run(engine: LintEngine, paths: Sequence[str], ref: str,
     return result
 
 
+#: one canary snippet per determinism-contract rule: the smallest
+#: program that must trip exactly that rule.  ``--selftest`` lints each
+#: in-memory — a sub-second end-to-end check that the whole pipeline
+#: (extraction -> taint -> rules) still catches the contract breaks it
+#: exists for, cheap enough to ride in the tier-1 report step.
+_SELFTEST_SNIPPETS = {
+    "JG117": ("import time\n"
+              "def emit(sink, r):\n"
+              "    t = time.time()\n"
+              "    rec = {'event': 'control', 'observed': t}\n"
+              "    sink.control_event(rec)\n"),
+    "JG118": ("SCHEMA_VERSION = 2\n"
+              "EVENTS = ('round',)\n"
+              "REQUIRED = {'round': ('event',)}\n"
+              "VERSION_LADDER = (\n"
+              "    {'version': 1, 'added_kinds': ('round',),\n"
+              "     'added_fields': ()},\n"
+              "    {'version': 2, 'added_kinds': (), 'added_fields': (),\n"
+              "     'removed_fields': ('loss',)},\n"
+              ")\n"),
+    "JG119": ("def emit(sink, xs):\n"
+              "    ids = [x for x in set(xs)]\n"
+              "    rec = {'event': 'client', 'clients': ids}\n"
+              "    sink.client_event(rec)\n"),
+    "JG120": ("def save_meta(n):\n"
+              "    meta = {'sx_orphan': n, 'sx_ok': 1}\n"
+              "    return meta\n"
+              "def restore_meta(meta):\n"
+              "    return meta['sx_ok']\n"),
+    "JG121": ("import numpy as np\n"
+              "def emit(sink, r):\n"
+              "    rng = np.random.default_rng()\n"
+              "    v = float(rng.normal())\n"
+              "    rec = {'event': 'serve', 'requests': v}\n"
+              "    sink.serve_event(rec)\n"),
+}
+
+_SELFTEST_CLEAN = (
+    "def emit(sink, seed, r):\n"
+    "    rec = {'event': 'control', 'round_index': r,\n"
+    "           'observed': seed + r}\n"
+    "    sink.control_event(rec)\n")
+
+
+def selftest() -> int:
+    """Exit 0 when the contract rules and tables are healthy."""
+    from .contracts import DEFAULT_TABLES
+
+    failures: List[str] = []
+    engine = LintEngine(ALL_RULES)
+    for rule_id, source in sorted(_SELFTEST_SNIPPETS.items()):
+        module, err = engine._parse(source, f"<selftest:{rule_id}>")
+        if module is None:
+            failures.append(f"{rule_id}: canary failed to parse ({err})")
+            continue
+        got = {f.rule_id for f in engine.lint_modules([module]).findings}
+        if got != {rule_id}:
+            fired = sorted(got) if got else "nothing"
+            failures.append(f"{rule_id}: canary fired {fired} instead")
+    module, _ = engine._parse(_SELFTEST_CLEAN, "<selftest:clean>")
+    got = {f.rule_id for f in engine.lint_modules([module]).findings}
+    if got:
+        failures.append(f"clean canary fired {sorted(got)}")
+
+    # the DEFAULT_TABLES mirror (used when the declaring modules are
+    # not in the lint run) must match what the declaring modules say
+    here = Path(__file__).resolve().parent.parent
+    declared: Dict[str, object] = {}
+    for rel in ("obs/schema.py", "control/replay.py"):
+        src = (here / rel).read_text()
+        module, _ = engine._parse(src, str(here / rel))
+        if module is None:
+            failures.append(f"{rel}: failed to parse for table check")
+            continue
+        for name, (value, _line) in \
+                extract_module_summary(module)["tables"].items():
+            declared[name] = value
+    for name, mirror in sorted(DEFAULT_TABLES.items()):
+        if name not in declared:
+            failures.append(f"table {name}: not declared in "
+                            "obs/schema.py or control/replay.py")
+        elif declared[name] != mirror:
+            failures.append(f"table {name}: DEFAULT_TABLES mirror is out "
+                            "of sync with the declaring module")
+
+    if failures:
+        for f in failures:
+            print(f"graftcheck selftest: FAIL: {f}", file=sys.stderr)
+        return 1
+    print(f"graftcheck selftest: ok ({len(_SELFTEST_SNIPPETS)} contract "
+          f"canaries, clean canary, {len(DEFAULT_TABLES)} tables in sync)")
+    return 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.selftest:
+        return selftest()
+    if not args.paths:
+        print("graftcheck: no paths given", file=sys.stderr)
+        return 2
     if args.json and args.sarif:
         print("graftcheck: --json and --sarif are mutually exclusive",
               file=sys.stderr)

@@ -100,12 +100,12 @@ class TraceSentinel:
 
     @property
     def traces(self) -> int:
-        return sum(self.counts.values())
+        return sum(sorted(self.counts.values()))
 
     @property
     def retraces(self) -> int:
         """Traces beyond the first per callable — the regressions."""
-        return sum(v - 1 for v in self.counts.values() if v > 0)
+        return sum(sorted(v - 1 for v in self.counts.values() if v > 0))
 
 
 def checkify_callable(fn: Callable) -> Callable:

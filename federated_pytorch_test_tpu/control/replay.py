@@ -35,6 +35,32 @@ _COMPARE_FIELDS = ("round_index", "intervention", "param", "from_value",
                    "to_value", "scope", "reason", "observed", "threshold",
                    "streak")
 
+# ------------------------------------------------------------------- #
+# Machine-readable replay-coverage contract (graftcheck JG118).
+#
+# The contract pass reads these tables via ast.literal_eval — pure
+# literals only.  Every record kind the recorder can emit must either
+# map to its check_* functions here (re-derived bit-exactly by replay)
+# or be declared exempt below.  JG118 flags an emitted kind covered by
+# neither, and flags a listed checker name with no matching function in
+# this module (the "deleted check_*" regression).
+
+#: replay-checked record kind -> the check_* functions that re-derive it
+REPLAY_CHECKERS = {
+    "control": ("check_policy_records", "check_supervisor_records",
+                "check_reshape_records"),
+    "client": ("check_cohort_records",),
+    "campaign": ("check_campaign_records",),
+    "serve": ("check_serve_records",),
+}
+
+#: kinds deliberately outside the bit-exact replay contract: envelope /
+#: timing streams (run_header, round, summary, span, compile) and the
+#: watchdog's threshold verdicts (alert) — their pure subsets are
+#: covered indirectly by the golden-digest and health tests instead
+REPLAY_EXEMPT_KINDS = ("run_header", "round", "summary", "span", "alert",
+                       "compile")
+
 
 def _decision_key(rec: Dict[str, Any]) -> Tuple:
     return tuple(rec.get(k) for k in _COMPARE_FIELDS)

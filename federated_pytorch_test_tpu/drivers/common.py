@@ -259,7 +259,7 @@ def maybe_load(trainer: BlockwiseFederatedTrainer, name: str):
     state = trainer.init_state()
     path = checkpoint_path(cfg, name)
     if cfg.load_model and os.path.isdir(os.path.abspath(os.path.expanduser(path))):
-        restored, _ = load_checkpoint(path, like=None)
+        restored, meta = load_checkpoint(path, like=None)
         from federated_pytorch_test_tpu.parallel.mesh import (
             client_sharding,
             stage_tree_global,
@@ -268,7 +268,8 @@ def maybe_load(trainer: BlockwiseFederatedTrainer, name: str):
         state = state._replace(
             params=stage_tree_global(restored["params"], csh),
             batch_stats=stage_tree_global(restored["batch_stats"], csh))
-        print(f"loaded checkpoint <- {path}")
+        rounds_prior = int(meta.get("rounds", 0)) if meta else 0
+        print(f"loaded checkpoint <- {path} (rounds={rounds_prior})")
     return state
 
 

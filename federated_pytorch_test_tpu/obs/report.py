@@ -652,6 +652,11 @@ def selftest() -> str:
     serve_swap.selftest()
     serve_infer.selftest()
     serve_evalstream.selftest()
+
+    from federated_pytorch_test_tpu.analysis import lint as analysis_lint
+    assert analysis_lint.selftest() == 0, \
+        "graftcheck determinism-contract selftest failed"
+
     return (table
             + "\nobs trace selftest: OK (Chrome trace valid)"
             + "\nobs health selftest: OK (NaN streak alerted)"
@@ -663,6 +668,8 @@ def selftest() -> str:
             "wall time only; harness maps knobs)"
             + "\nserve selftests: OK (batcher deterministic; swap "
             "never torn; predictor pads to buckets; drift scored)"
+            + "\ngraftcheck contract selftest: OK (JG117-JG121 canaries "
+            "fire; contract tables in sync)"
             + "\nobs report selftest: OK")
 
 

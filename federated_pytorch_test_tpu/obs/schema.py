@@ -445,6 +445,121 @@ REQUIRED = {
               "weights_version", "requests"),
 }
 
+# ------------------------------------------------------------------- #
+# Machine-readable determinism contract (graftcheck JG117-JG121).
+#
+# The contract pass (analysis/contracts.py) reads these tables via
+# ast.literal_eval — it never imports this module — so every table below
+# MUST stay a pure literal (no comprehensions, no function calls, no
+# name references).  The lint selftest cross-checks the extracted values
+# against the live module to keep the two views from drifting.
+
+#: fields that are wall-clock / host-measured / model-dependent by
+#: design and therefore exempt from the replay contract: they may be fed
+#: by time.* or measurement state, and control/replay.py never compares
+#: them.  Everything NOT in this tuple (or ENVELOPE_FIELDS) is a core
+#: field: a pure function of (seed, config, round coordinates), and
+#: JG117/JG119/JG121 flag any entropy, iteration-order or rogue-PRNG
+#: taint flowing into it.  PARITY.md pins this list as part of the
+#: v0.15 contract — additions need a schema-comment + PARITY note.
+ADVISORY_FIELDS = (
+    # wall-clock stamps + per-round host timings (v1..v7)
+    "time_unix", "round_seconds", "stage_seconds", "train_seconds",
+    "comm_seconds", "sync_seconds", "compute_seconds", "epoch_seconds",
+    "ckpt_write_seconds", "overlap_seconds", "compile_seconds",
+    "t_start", "t_end",
+    # serving-plane latency/throughput telemetry (v13)
+    "serve_p50_ms", "serve_p99_ms", "serve_qps", "swap_gap_seconds",
+    "serve_accuracy", "drift_score", "forced_refresh",
+    # summary wall-clock totals and derived rates
+    "total_seconds", "round_seconds_total", "stage_seconds_total",
+    "comm_seconds_total", "compile_seconds_total",
+    "rounds_per_sec", "images_per_sec", "comm_overhead_frac",
+    # bench artifact fields, declared here rather than silently
+    # exempted: the capture timestamp and the relay's last error text
+    # are operator-facing diagnostics, never replay-checked
+    "captured_utc", "last_error",
+)
+
+#: run/record identity fields stamped by the recorder envelope — host
+#: facts (pid, git rev, jax versions) and the uuid-derived span ids.
+#: They identify *which* run produced a stream; replay compares streams
+#: only within one run, so envelope fields are outside the taint rules.
+ENVELOPE_FIELDS = (
+    "event", "schema", "run_id", "run_name", "span_id", "parent_span",
+    "engine", "algorithm", "host", "pid", "git_rev", "devices",
+    "local_devices", "platform", "jax_version", "jaxlib_version",
+    "resumed", "rounds_prior", "config", "mesh_shape",
+)
+
+#: out-of-band diagnostic emissions that look like records (they carry
+#: an "event" key for grep-ability) but never enter a telemetry stream —
+#: JG118's emit-coverage check allows them without a replay checker
+DIAGNOSTIC_KINDS = ("sink_degraded",)
+
+#: checkpoint-meta key namespaces reserved for one owner module (JG120):
+#: a namespace ending in "_" is a prefix, anything else an exact key;
+#: the owner tuple lists module-path suffixes allowed to write it
+RESERVED_META_NAMESPACES = (
+    ("pop_", ("population.registry",)),
+    ("geom_", ("utils.checkpoint",)),
+    ("members", ("utils.checkpoint",)),
+)
+
+#: the additive version history, machine-readable (the prose history
+#: lives in the comment block above SCHEMA_VERSION).  JG118 asserts the
+#: ladder is strictly increasing, carries no "removed_fields"/
+#: "removed_kinds" entries (additive-only discipline), tops out at
+#: SCHEMA_VERSION, and that every EVENTS kind was introduced by exactly
+#: one rung and has a non-empty REQUIRED core.
+VERSION_LADDER = (
+    {"version": 1,
+     "added_kinds": ("run_header", "round", "summary"),
+     "added_fields": ()},
+    {"version": 2, "added_kinds": (),
+     "added_fields": ("jit_retraces",)},
+    {"version": 3, "added_kinds": (),
+     "added_fields": ("host_dispatches", "ckpt_write_seconds")},
+    {"version": 4, "added_kinds": (),
+     "added_fields": ("async_mode", "max_staleness", "async_arrived",
+                      "admission_rejected", "buffer_depth",
+                      "staleness_hist")},
+    {"version": 5, "added_kinds": ("span", "alert"),
+     "added_fields": ("span_id", "parent_span", "t_start", "t_end",
+                      "alerts_total")},
+    {"version": 6, "added_kinds": ("compile",),
+     "added_fields": ("site", "compile_seconds", "trace_count", "flops",
+                      "hlo_bytes_accessed", "transcendentals",
+                      "cache_hit")},
+    {"version": 7, "added_kinds": (),
+     "added_fields": ("bytes_fused", "overlap_seconds")},
+    {"version": 8, "added_kinds": ("control",),
+     "added_fields": ("source", "intervention", "param", "from_value",
+                      "to_value", "scope", "mode", "applied", "reason",
+                      "attempt", "backoff_seconds", "ladder_stage",
+                      "interventions_total")},
+    {"version": 9, "added_kinds": (),
+     "added_fields": ("members_active", "joined", "left")},
+    {"version": 10, "added_kinds": ("client",),
+     "added_fields": ("clients", "update_norm", "dist_z", "loss_client",
+                      "weight", "active", "guard_ok", "quarantine",
+                      "dropped", "straggled", "corrupted", "staleness",
+                      "admitted", "members", "payload_bytes")},
+    {"version": 11, "added_kinds": (),
+     "added_fields": ("registry_ids",)},
+    {"version": 12, "added_kinds": ("campaign",),
+     "added_fields": ("virtual_seconds", "arrival_frac", "drop_p",
+                      "straggle_p", "corrupt_p", "join_p", "leave_p",
+                      "storm", "burst", "preempt_now", "phase")},
+    {"version": 13, "added_kinds": ("serve",),
+     "added_fields": ("weights_version", "requests", "batches",
+                      "padded_slots", "padding_waste_frac",
+                      "drift_injected", "swap", "serve_p50_ms",
+                      "serve_p99_ms", "serve_qps", "swap_gap_seconds",
+                      "serve_accuracy", "drift_score",
+                      "forced_refresh")},
+)
+
 
 def json_safe(obj):
     """Coerce ``obj`` into JSON-serialisable types.

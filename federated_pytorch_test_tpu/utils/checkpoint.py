@@ -104,6 +104,13 @@ def validate_geometry(meta: Dict[str, Any], *, devices: int, processes: int,
             "(cfg.elastic_resume=True) to restage the client axis onto "
             "the new mesh, or resume on the original device count for "
             "bitwise continuation")
+    ck_p = int(meta.get("geom_processes", processes))
+    if ck_p != processes and not elastic:
+        raise CheckpointGeometryError(
+            f"checkpoint was written by a {ck_p}-process job but this "
+            f"run has {processes} processes; a process-count change "
+            "reshards the global arrays, so it is only legal under "
+            "--elastic-resume (cfg.elastic_resume=True)")
 
 
 def _abspath(path: str) -> str:

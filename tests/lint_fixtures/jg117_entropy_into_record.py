@@ -1,0 +1,22 @@
+"""JG117 fixture: wall-clock entropy through a call edge into a record.
+
+``now()`` returns ``time.time()``; the value crosses the call edge into
+``emit`` and lands in ``observed`` — a replay-checked core field of a
+``control`` record — so control.replay could never re-derive it.  Had
+the field been ``time_unix`` (declared in ADVISORY_FIELDS) the store
+would be exempt.  Exactly JG117: the kind is replay-covered (no JG118),
+nothing is unordered (JG119), no meta carrier (JG120), and no rng
+lineage is involved (JG121).
+"""
+import time
+
+
+def now():
+    return time.time()
+
+
+def emit(rec_sink, round_index):
+    stamp = now()
+    rec = {"event": "control", "round_index": round_index,
+           "observed": stamp}
+    rec_sink.control_event(rec)

@@ -1,0 +1,20 @@
+"""JG118 fixture: a non-additive VERSION_LADDER rung.
+
+The v3 rung carries ``removed_fields`` — the additive-schema contract
+says a bump may only ever *add* kinds/fields, because removing one
+breaks every reader of an older stream.  Everything else about the
+ladder is consistent (strictly increasing, tops out at SCHEMA_VERSION,
+the one kind is introduced exactly once and has a REQUIRED core), so
+exactly one JG118 finding fires.
+"""
+SCHEMA_VERSION = 3
+
+EVENTS = ("round",)
+
+REQUIRED = {"round": ("event", "schema")}
+
+VERSION_LADDER = (
+    {"version": 1, "added_kinds": ("round",), "added_fields": ()},
+    {"version": 3, "added_kinds": (), "added_fields": (),
+     "removed_fields": ("loss",)},
+)

@@ -1,0 +1,16 @@
+"""JG119 fixture: set-iteration order feeding a recorded field.
+
+The cohort ids are materialised by iterating a ``set`` — hash order,
+not a function of (seed, config, round coords) — and land in the
+``clients`` field of a ``client`` record.  ``sorted(set(cohort))``
+would restore the contract.  Exactly JG119: no entropy (JG117), the
+kind is replay-covered (JG118), no meta carrier (JG120), no rng
+lineage (JG121).
+"""
+
+
+def emit(rec_sink, cohort, round_index):
+    ids = [c for c in set(cohort)]
+    rec = {"event": "client", "round_index": round_index,
+           "clients": ids}
+    rec_sink.client_event(rec)

@@ -1,0 +1,17 @@
+"""JG120 fixture: a checkpoint-meta key with no restore-side reader.
+
+``fx_orphan`` is stamped into every checkpoint by the save path but no
+restore path ever looks at it — either dead weight, or (worse) a
+restore-side validation that silently never happens.  ``fx_rounds`` is
+balanced (written here, read hard in ``restore_meta``), so exactly one
+JG120 finding fires, anchored at the orphan write.
+"""
+
+
+def save_meta(nloop):
+    meta = {"fx_rounds": nloop, "fx_orphan": 1}
+    return meta
+
+
+def restore_meta(meta):
+    return int(meta["fx_rounds"])

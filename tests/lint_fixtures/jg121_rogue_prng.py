@@ -1,0 +1,20 @@
+"""JG121 fixture: a record-feeding draw outside the seeded lineage.
+
+``default_rng()`` with no seed draws from OS entropy; the draw lands in
+``requests`` — a replay-checked core field of a ``serve`` record — so
+replay could never re-draw the same value.  The seeded contract wants
+``default_rng(cfg_seed)`` (or jax ``fold_in(key, round_index)``)
+lineage instead.  Exactly JG121: the generator name is statically known
+rng lineage, so the entropy pass (JG117) deliberately leaves it to this
+rule; kind is covered (JG118), nothing unordered (JG119), no meta
+carrier (JG120).
+"""
+import numpy as np
+
+
+def emit(rec_sink, round_index):
+    rng = np.random.default_rng()
+    requests = int(rng.integers(0, 100))
+    rec = {"event": "serve", "round_index": round_index,
+           "weights_version": 1, "requests": requests}
+    rec_sink.serve_event(rec)

@@ -102,11 +102,12 @@ class TestGraftcheckClean:
             assert cache.exists()
 
     def test_flow_rules_active_in_gate(self):
-        """The clean gate is not vacuous for the interprocedural and
-        concurrency layers: ALL_RULES must carry the full JG101-JG116
-        set (so the assertions above ran all sixteen over the tree)."""
+        """The clean gate is not vacuous for the interprocedural,
+        concurrency and determinism-contract layers: ALL_RULES must
+        carry the full JG101-JG121 set (so the assertions above ran all
+        twenty-one over the tree)."""
         ids = {r.id for r in ALL_RULES}
-        assert {f"JG{n}" for n in range(101, 117)} <= ids
+        assert {f"JG{n}" for n in range(101, 122)} <= ids
 
     def test_jg115_is_error_severity(self):
         """--fail-on error still gates threaded JAX dispatch: JG115 is
