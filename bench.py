@@ -151,6 +151,62 @@ def _precheck_timeout() -> float:
     return float(os.environ.get("FEDTPU_BENCH_PRECHECK_TIMEOUT_S", 20.0))
 
 
+#: environment prefixes that decide relay/backend behavior — the wedge
+#: diagnosis snapshots these so a wedged artifact records WHICH relay the
+#: process was pointed at (r03-r05 needed session-log archaeology for it)
+_RELAY_ENV_PREFIXES = ("PALLAS_AXON", "JAX_", "TPU_", "XLA_")
+
+
+def _diagnose_wedge(pid: int) -> dict:
+    """Structured snapshot of a STILL-RUNNING hung pre-check child:
+    where in the kernel it is blocked and what relay configuration it
+    inherited.  Reads /proc (state, wchan, the blocked syscall number,
+    thread count) — the no-ptrace equivalent of ``strace -p``, which the
+    sandboxed bench box typically cannot run — plus the relay-relevant
+    environment.  Every read is best-effort: the child can die between
+    reads, and a partial snapshot still beats the r03-r05 situation
+    (wedge closed from symptoms with zero forensics).  The caller embeds
+    the dict under ``relay_status.diagnosis`` and summarizes it into
+    ``relay_status.last_error``."""
+    diag: dict = {"pid": pid}
+
+    def read(name):
+        try:
+            with open(f"/proc/{pid}/{name}") as f:
+                return f.read().strip()
+        except OSError:
+            return None
+
+    status = read("status") or ""
+    for line in status.splitlines():
+        if line.startswith("State:"):
+            diag["proc_state"] = line.split(":", 1)[1].strip()
+        elif line.startswith("Threads:"):
+            diag["threads"] = line.split(":", 1)[1].strip()
+    # which kernel wait channel the main thread sleeps in (e.g.
+    # futex_wait / unix_stream_read_generic / poll_schedule_timeout):
+    # distinguishes "waiting on the relay socket" from "deadlocked on an
+    # in-process lock" — THE question r03-r05 could not answer
+    diag["wchan"] = read("wchan")
+    # /proc/<pid>/syscall: "<nr> args... sp pc" for a blocked thread —
+    # readable same-user without ptrace on most kernels
+    sc = read("syscall")
+    if sc:
+        diag["syscall"] = sc.split()[0]
+    env = read("environ")
+    if env is not None:
+        diag["env"] = {
+            k: v for k, v in
+            (kv.split("=", 1) for kv in env.split("\0") if "=" in kv)
+            if k.startswith(_RELAY_ENV_PREFIXES)}
+    else:
+        # child env unreadable (already reaped / hardened /proc): fall
+        # back to our own — the child inherited it
+        diag["env"] = {k: v for k, v in os.environ.items()
+                       if k.startswith(_RELAY_ENV_PREFIXES)}
+    return diag
+
+
 def _acquire_backend(attempts: int = 3, probe_timeout: Optional[float] = None,
                      backoff: float = 15.0) -> tuple:
     """Probe the TPU backend in a SUBPROCESS (bounded; the axon relay wedge
@@ -203,18 +259,34 @@ def _acquire_backend(attempts: int = 3, probe_timeout: Optional[float] = None,
         # records the real error.
         wedged = False
         if pre_timeout > 0:
+            # Popen (not subprocess.run) so a hung child is still ALIVE
+            # when we snapshot it: the r03-r05 wedges were closed as
+            # "relay wedged" from symptoms alone because by the time
+            # anyone looked, the hung process was gone — _diagnose_wedge
+            # reads /proc/<pid> (state, wchan, blocking syscall, child
+            # threads) and the relay-relevant environment BEFORE the kill
+            p = subprocess.Popen(
+                [sys.executable, "-c", _PRECHECK],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
             try:
-                r = subprocess.run(
-                    [sys.executable, "-c", _PRECHECK],
-                    timeout=pre_timeout, capture_output=True, text=True)
-                _RELAY_STATUS["precheck"] = ("ok" if r.returncode == 0
+                p.communicate(timeout=pre_timeout)
+                _RELAY_STATUS["precheck"] = ("ok" if p.returncode == 0
                                              else "failed")
             except subprocess.TimeoutExpired:
                 _RELAY_STATUS["precheck"] = "hung"
+                _RELAY_STATUS["diagnosis"] = _diagnose_wedge(p.pid)
+                p.kill()
+                p.communicate()
                 wedged = True
         if wedged:
+            diag = _RELAY_STATUS.get("diagnosis") or {}
             err = (f"tpu relay pre-check hung >{pre_timeout:.0f}s "
-                   "(wedged-relay signature); skipping probes")
+                   "(wedged-relay signature); skipping probes"
+                   + (f"; pid {diag.get('pid')} "
+                      f"state={diag.get('proc_state')} "
+                      f"wchan={diag.get('wchan')} "
+                      f"syscall={diag.get('syscall')}"
+                      if diag else ""))
             _RELAY_STATUS.update(state="wedged", last_error=err)
             print(f"bench: {err}", file=sys.stderr)
         else:
@@ -226,19 +298,31 @@ def _acquire_backend(attempts: int = 3, probe_timeout: Optional[float] = None,
                     # 2x backoff, ...
                     time.sleep(backoff * 2 ** (attempt - 1))
                 used = attempt + 1
+                p = subprocess.Popen(
+                    [sys.executable, "-c", _PROBE],
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                    text=True)
                 try:
-                    r = subprocess.run(
-                        [sys.executable, "-c", _PROBE],
-                        timeout=probe_timeout, capture_output=True, text=True)
-                    if r.returncode == 0:
+                    _, perr = p.communicate(timeout=probe_timeout)
+                    if p.returncode == 0:
                         _RELAY_STATUS.update(state="healthy",
                                              probes_used=used)
                         return None, used
-                    last = (r.stderr.strip().splitlines()
-                            or ["rc=%d" % r.returncode])[-1]
+                    last = ((perr or "").strip().splitlines()
+                            or ["rc=%d" % p.returncode])[-1]
                 except subprocess.TimeoutExpired:
+                    # a probe that hangs AFTER a passing pre-check is the
+                    # other wedge variant (import fine, first device
+                    # query never returns): snapshot it alive too
+                    diag = _diagnose_wedge(p.pid)
+                    _RELAY_STATUS["diagnosis"] = diag
+                    p.kill()
+                    p.communicate()
                     last = (f"TPU probe hung >{probe_timeout:.0f}s "
-                            "(relay wedged?)")
+                            f"(relay wedged?); pid {diag.get('pid')} "
+                            f"state={diag.get('proc_state')} "
+                            f"wchan={diag.get('wchan')} "
+                            f"syscall={diag.get('syscall')}")
                 print(f"bench: TPU probe {attempt + 1}/{attempts} failed: "
                       f"{last}", file=sys.stderr)
             err = f"tpu backend unavailable after {attempts} probes: {last}"
@@ -1164,6 +1248,66 @@ def _smoke_predicted() -> dict:
     topk = make_compressor("topk", topk_frac=0.01)
     out["smoke_fused_topk_wire_bytes"] = int(
         fused_bytes_on_wire(topk, N, D, K))
+    # chunked robust aggregation (--robust-chunked): predicted per-device
+    # gathered working set from the pure byte model — dense materializes
+    # the [K, N] all-gather, chunked owns a [K, ceil(N/D)] segment slab
+    # (parallel/comm.py robust_gather_bytes); the compiled
+    # memory_analysis counterpart is gated below (_smoke_robust_memory)
+    from federated_pytorch_test_tpu.parallel.comm import robust_gather_bytes
+    for kind in ("trim", "krum"):
+        dense = robust_gather_bytes(kind, K, N, D, chunked=False)
+        chunk_b = robust_gather_bytes(kind, K, N, D, chunked=True)
+        out[f"smoke_robust_{kind}_dense_gather_bytes"] = int(dense)
+        out[f"smoke_robust_{kind}_chunked_gather_bytes"] = int(chunk_b)
+        out[f"smoke_robust_{kind}_gather_savings_ratio"] = round(
+            dense / chunk_b, 4)
+    return out
+
+
+def _smoke_robust_memory() -> dict:
+    """Compiled-memory gate for the chunked robust-agg path: lower each
+    estimator through jit on the forced 8-device CPU mesh at the static
+    smoke geometry and read ``memory_analysis`` peak bytes (argument +
+    output + temp, the obs/costs.py definition) for the dense all-gather
+    formulation vs the ``--robust-chunked`` segment-owned one.  These are
+    compiler facts, not timings — deterministic for a fixed jax/XLA
+    build, so the committed-baseline diff holds them down like the
+    predicted byte fields; the hard "chunked strictly lower" assertion
+    lives in tests/test_comm_kernels.py."""
+    import jax
+    import jax.numpy as jnp
+
+    from federated_pytorch_test_tpu.parallel.comm import (
+        make_robust_mean,
+    )
+    from federated_pytorch_test_tpu.parallel.mesh import (
+        CLIENT_AXIS,
+        client_mesh,
+        shard_map,
+    )
+
+    P = jax.sharding.PartitionSpec
+    N, K, D = 8192, 8, 8
+    mesh = client_mesh(D)
+    out = {}
+
+    def peak(kind, chunked):
+        mf = make_robust_mean(kind, trim_frac=0.1, chunked=chunked, D=D)
+        fn = shard_map(lambda s, w: mf(s, w), mesh=mesh,
+                       in_specs=(P(CLIENT_AXIS), P(CLIENT_AXIS)),
+                       out_specs=P(), check_vma=False)
+        shapes = (jax.ShapeDtypeStruct((K, N), jnp.float32),
+                  jax.ShapeDtypeStruct((K,), jnp.float32))
+        stats = jax.jit(fn).lower(*shapes).compile().memory_analysis()
+        return int(stats.argument_size_in_bytes
+                   + stats.output_size_in_bytes
+                   + stats.temp_size_in_bytes)
+
+    for kind in ("trim", "krum"):
+        out[f"smoke_robust_{kind}_dense_peak_device_bytes"] = peak(
+            kind, False)
+        out[f"smoke_robust_{kind}_chunked_peak_device_bytes"] = peak(
+            kind, True)
     return out
 
 
@@ -1253,6 +1397,10 @@ def _smoke() -> int:
     out.update(_smoke_predicted())
     out["value"] = round(out["smoke_dense_collective_wire_bytes"]
                          / out["smoke_fused_q8_wire_bytes"], 4)
+    try:
+        out.update(_smoke_robust_memory())
+    except Exception as e:      # noqa: BLE001 — predicted gate still runs
+        out["error"] = f"smoke robust memory failed: {type(e).__name__}: {e}"
     try:
         out.update(_smoke_engine_run())
     except Exception as e:      # noqa: BLE001 — predicted gate still runs

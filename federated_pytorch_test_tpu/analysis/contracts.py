@@ -57,7 +57,7 @@ DEFAULT_TABLES: Dict[str, object] = {
         "time_unix", "round_seconds", "stage_seconds", "train_seconds",
         "comm_seconds", "sync_seconds", "compute_seconds",
         "epoch_seconds", "ckpt_write_seconds", "overlap_seconds",
-        "compile_seconds", "t_start", "t_end",
+        "overlap_dispatch_seconds", "compile_seconds", "t_start", "t_end",
         "serve_p50_ms", "serve_p99_ms", "serve_qps", "swap_gap_seconds",
         "serve_accuracy", "drift_score", "forced_refresh",
         "total_seconds", "round_seconds_total", "stage_seconds_total",

@@ -16,9 +16,9 @@ from __future__ import annotations
 from typing import Any, Tuple
 
 import jax.numpy as jnp
-from jax import lax
 
 from federated_pytorch_test_tpu.compress.base import Compressor
+from federated_pytorch_test_tpu.ops.topk_select import top_k_abs_indices
 
 
 class TopK(Compressor):
@@ -34,9 +34,12 @@ class TopK(Compressor):
         return max(1, min(n, int(round(self.frac * n))))
 
     def encode(self, vec, state) -> Tuple[Any, Any]:
+        # selection dispatches through ops/topk_select: single-shot
+        # lax.top_k on CPU, chunked two-stage on TPU — bitwise-identical
+        # index sets by the tie-break argument documented there
         k = self.k_for(vec.shape[0])
-        _, idx = lax.top_k(jnp.abs(vec), k)
-        return {"idx": idx.astype(jnp.int32), "val": vec[idx]}, state
+        idx = top_k_abs_indices(vec, k)
+        return {"idx": idx, "val": vec[idx]}, state
 
     def decode(self, payload, n: int):
         return jnp.zeros((n,), payload["val"].dtype).at[

@@ -76,6 +76,12 @@ def _direction(name: str) -> int:
         return -1
     if name.endswith("_savings_ratio"):
         return +1
+    # chunked robust-agg gate (bench.py --smoke): the predicted gathered
+    # working set and the compiled memory_analysis peak both regress UP
+    if name.endswith("_gather_bytes"):
+        return -1
+    if name.endswith("_peak_device_bytes"):
+        return -1
     # soak gate fields on bench --soak artifacts (soak_availability_pct
     # headline + soak_rounds_lost section metric)
     if name.endswith("_availability_pct"):

@@ -185,8 +185,17 @@ from typing import Any, Dict
 # rule) and `forced_refresh` (a control-plane serve_swap intervention
 # republished the weights this round).  Serving-off streams carry no
 # `serve` records and stay byte-identical to v12.
-# v1..v12 records remain valid: validate_record accepts ver <= SCHEMA_VERSION.
-SCHEMA_VERSION = 13
+# v14 (additive): whole-round compute/comm overlap (--overlap-round) —
+# per-round `overlap_dispatch_seconds`, the host wall-clock spent
+# enqueueing the NEXT round's first train epoch while this round's comm
+# collective was still executing on-device (train/engine.py
+# _predispatch_round).  Advisory (a host timing, like overlap_seconds);
+# present only when --overlap-round is active, 0.0 on the last round of
+# a block (the pre-dispatch is gated to same-block successors) and
+# whenever the lookahead cache was already spent.  Overlap-off streams
+# carry no such field and stay byte-identical to v13.
+# v1..v13 records remain valid: validate_record accepts ver <= SCHEMA_VERSION.
+SCHEMA_VERSION = 14
 
 EVENTS = ("run_header", "round", "summary", "span", "alert", "compile",
           "control", "client", "campaign", "serve")
@@ -266,6 +275,8 @@ FIELDS: Dict[str, Any] = {
     # roofline comm path (schema v7; --fused-collective/--overlap-staging)
     "bytes_fused":  (("round",), _INT),
     "overlap_seconds": (("round",), _NUM),
+    # whole-round overlap (schema v14; --overlap-round)
+    "overlap_dispatch_seconds": (("round",), _NUM),
     # fault / guard counters
     "guard_trips":  (("round",), _NUM),
     "guard_norm_mean": (("round",), _NUM),
@@ -466,8 +477,8 @@ ADVISORY_FIELDS = (
     # wall-clock stamps + per-round host timings (v1..v7)
     "time_unix", "round_seconds", "stage_seconds", "train_seconds",
     "comm_seconds", "sync_seconds", "compute_seconds", "epoch_seconds",
-    "ckpt_write_seconds", "overlap_seconds", "compile_seconds",
-    "t_start", "t_end",
+    "ckpt_write_seconds", "overlap_seconds", "overlap_dispatch_seconds",
+    "compile_seconds", "t_start", "t_end",
     # serving-plane latency/throughput telemetry (v13)
     "serve_p50_ms", "serve_p99_ms", "serve_qps", "swap_gap_seconds",
     "serve_accuracy", "drift_score", "forced_refresh",
@@ -558,6 +569,8 @@ VERSION_LADDER = (
                       "serve_p99_ms", "serve_qps", "swap_gap_seconds",
                       "serve_accuracy", "drift_score",
                       "forced_refresh")},
+    {"version": 14, "added_kinds": (),
+     "added_fields": ("overlap_dispatch_seconds",)},
 )
 
 

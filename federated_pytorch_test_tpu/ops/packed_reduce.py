@@ -32,20 +32,23 @@ exact without threading PRNG state through the collective.
 
 CPU fallback is the same code path: ``ppermute``/``all_gather`` lower
 fine on the virtual CPU mesh, and ``D == 1`` skips collectives
-entirely.  An optional Pallas TPU kernel for the quantize step is
-gated behind ``FEDTPU_FUSED_PALLAS=1`` (off by default; the jnp
-lowering is what tier-1 exercises).
+entirely.  The quantize and dequantize-accumulate steps dispatch
+through ``ops/comm_kernels.py`` (fused Pallas kernels on TPU, the
+literal jnp chain elsewhere — auto-selected, no env flag; tests pin
+either side via ``force_comm_kernels_impl``).
 """
 
 from __future__ import annotations
 
-import os
 from typing import Callable, Optional, Tuple
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 
+from federated_pytorch_test_tpu.ops.comm_kernels import (
+    dequant_add,
+    quantize_chunks,
+)
 from federated_pytorch_test_tpu.parallel.mesh import CLIENT_AXIS
 
 __all__ = [
@@ -86,64 +89,48 @@ def transport_params(compressor) -> Optional[Tuple[int, int]]:
     return None
 
 
-def _use_pallas() -> bool:
-    return (os.environ.get("FEDTPU_FUSED_PALLAS", "0") == "1"
-            and jax.default_backend() == "tpu")
-
-
-def _quantize_rows(vv, safe, qmax):
-    """Round-to-nearest int8 rows ``clip(round(vv/safe), ±qmax)``;
-    Pallas VPU kernel on TPU when opted in, jnp elsewhere."""
-    if _use_pallas():
-        try:
-            return _quantize_rows_pallas(vv, safe, qmax)
-        except Exception:                         # pragma: no cover - TPU only
-            pass                                  # jnp lowering is always valid
-    return jnp.clip(jnp.round(vv / safe[:, None]), -qmax, qmax
-                    ).astype(jnp.int8)
-
-
-def _quantize_rows_pallas(vv, safe, qmax):       # pragma: no cover - TPU only
-    """Single-block elementwise quantize kernel: the divide/round/clip
-    chain stays in VMEM instead of round-tripping HBM between the XLA
-    fusions on either side of the collective."""
-    from jax.experimental import pallas as pl
-
-    def kernel(v_ref, s_ref, o_ref):
-        q = jnp.round(v_ref[...] / s_ref[...])
-        o_ref[...] = jnp.clip(q, -qmax, qmax).astype(jnp.int8)
-
-    return pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct(vv.shape, jnp.int8),
-    )(vv, safe[:, None] * jnp.ones((1, vv.shape[1]), vv.dtype))
-
-
 def pack_chunks(v, chunk: int, bits: int):
     """Deterministic per-chunk transport encode of ``v`` (``[m]`` f32,
     ``m % chunk == 0``): returns ``(q, scale)`` with the same chunk
     layout as compress/quantize.py (scale = max|chunk|/qmax, int4
-    payloads nibble-packed two-per-byte)."""
+    payloads nibble-packed two-per-byte).  Scale + round/clip run as
+    ONE fused kernel (ops/comm_kernels.quantize_chunks); the nibble
+    fold is a pure byte shuffle that XLA keeps inside the surrounding
+    fusion either way."""
     qmax = 2 ** (bits - 1) - 1
     c = v.shape[0] // chunk
-    vv = v.reshape(c, chunk)
-    scale = jnp.max(jnp.abs(vv), axis=1) / qmax
-    safe = jnp.where(scale > 0, scale, 1.0).astype(v.dtype)
-    q = _quantize_rows(vv, safe, qmax)
+    q, scale = quantize_chunks(v.reshape(c, chunk), qmax)
     if bits == 4:
         nib = (q + 8).astype(jnp.uint8)
         q = (nib[:, 0::2] << 4) | nib[:, 1::2]
-    return q, scale.astype(jnp.float32)
+    return q, scale
 
 
-def unpack_chunks(q, scale, chunk: int, bits: int):
-    """Inverse of :func:`pack_chunks` → flat ``[c*chunk]`` f32."""
+def _unfold_rows(q, bits: int):
+    """Nibble-unfold q4 payload rows back to int8 rows (identity for
+    q8) — the byte shuffle stays outside the fused kernels."""
     if bits == 4:
         hi = (q >> 4).astype(jnp.int8) - 8
         lo = (q & 0xF).astype(jnp.int8) - 8
         q = jnp.stack([hi, lo], axis=-1).reshape(q.shape[0], -1)
+    return q
+
+
+def unpack_chunks(q, scale, chunk: int, bits: int):
+    """Inverse of :func:`pack_chunks` → flat ``[c*chunk]`` f32."""
+    q = _unfold_rows(q, bits)
     safe = jnp.where(scale > 0, scale, 1.0)
     return (q.astype(jnp.float32) * safe[:, None]).reshape(-1)
+
+
+def _hop_accumulate(acc, q, scale, chunk: int, bits: int):
+    """The reduce-scatter hop's ``acc + decode(q, scale)`` as one fused
+    dequantize-accumulate (ops/comm_kernels.dequant_add).  Bitwise the
+    old ``acc + unpack_chunks(...)`` on the XLA path: reshape commutes
+    with the elementwise add."""
+    c = scale.shape[0]
+    out = dequant_add(acc.reshape(c, chunk), _unfold_rows(q, bits), scale)
+    return out.reshape(-1)
 
 
 def _seg_elems(n: int, D: int, chunk: int) -> int:
@@ -171,7 +158,7 @@ def _butterfly_reduce_scatter(buf, D: int, seg: int, chunk: int, bits: int,
         q = lax.ppermute(q, axis_name, perm)
         s = lax.ppermute(s, axis_name, perm)
         kept = lax.dynamic_slice(buf, (keep_lo,), (width,))
-        kept = kept + unpack_chunks(q, s, chunk, bits)
+        kept = _hop_accumulate(kept, q, s, chunk, bits)
         buf = lax.dynamic_update_slice(buf, kept, (keep_lo,))
         lo = keep_lo
         half //= 2
@@ -193,7 +180,7 @@ def _ring_reduce_scatter(buf, D: int, seg: int, chunk: int, bits: int,
         s = lax.ppermute(s, axis_name, perm)
         recv_lo = ((me - 1 - t) % D) * seg
         acc = lax.dynamic_slice(buf, (recv_lo,), (seg,))
-        acc = acc + unpack_chunks(q, s, chunk, bits)
+        acc = _hop_accumulate(acc, q, s, chunk, bits)
         buf = lax.dynamic_update_slice(buf, acc, (recv_lo,))
     return buf, ((me + 1) % D) * seg
 

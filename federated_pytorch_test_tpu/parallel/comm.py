@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from federated_pytorch_test_tpu.ops.comm_kernels import gram_matrix
 from federated_pytorch_test_tpu.parallel.mesh import (    # noqa: F401
     CLIENT_AXIS, CollectiveTimeoutError, bounded_wait)
 # CollectiveTimeoutError/bounded_wait re-exported here: comm.py is the
@@ -311,6 +312,169 @@ def robust_federated_mean(x: jnp.ndarray, w=None, *, kind: str,
             / jnp.where(den > 0, den, 1.0))
 
 
+def robust_federated_mean_chunked(x: jnp.ndarray, w=None, *, kind: str,
+                                  trim_frac: float = 0.1,
+                                  clip_mult: float = 3.0, D: int,
+                                  axis_name: str = CLIENT_AXIS
+                                  ) -> jnp.ndarray:
+    """Segment-owned robust aggregation: the ``--robust-chunked`` path.
+
+    :func:`robust_federated_mean` starts from ``all_gather`` — every
+    device materializes the full ``[K, N]`` client matrix, which is the
+    single largest temporary of the comm program (the exact buffer
+    ISSUE 11 eliminated from the *plain* mean via ``psum_scatter``).
+    Here one tiled ``all_to_all`` transposes ownership instead: device
+    ``d`` receives column segment ``d`` of every client's vector — a
+    ``[K, ceil(N/D)]`` slab, ``1/D`` of the gathered matrix — computes
+    the robust estimate for its own coordinates, and one tiled
+    ``all_gather`` of the ``[seg]`` results re-replicates the ``[N]``
+    aggregate.  Same wire volume as the gather (every element still
+    crosses the wire once, plus the small result gather); ``1/D`` the
+    peak working set — gated by compiled ``memory_analysis``
+    ``peak_device_bytes`` in the tests, not prose.
+
+    Per-kind determinism contract vs the dense path (PARITY.md):
+
+    - ``trim`` / ``median`` are coordinate-wise: each coordinate sees
+      the identical K values, sort and window arithmetic included, so
+      the chunked result is **bitwise** the dense result.
+    - ``clip`` / ``geomed`` reduce per-client norms across the segment
+      axis via ``psum`` (re-associated sums), and ``krum`` accumulates
+      its Gram matrix per segment (through the
+      ``ops/comm_kernels.gram_matrix`` dispatch on top) — allclose,
+      not bitwise.
+
+    The non-finite screen is exact, not approximated: per-segment
+    non-finite counts are psum'd, so a client with a NaN anywhere in
+    its row is folded out on every device, exactly as the dense path's
+    full-row ``isfinite`` scan.  ``krum``'s distance pass reads
+    ``sq_i = G_ii`` off the psum'd Gram diagonal instead of a separate
+    norm pass — one streamed kernel feeds both the norms and the
+    cross-terms (the "fused guard + distance" shape of the ISSUE).
+    """
+    if kind not in ROBUST_AGG_CHOICES[1:]:
+        raise ValueError(f"unknown robust aggregation {kind!r}; expected "
+                         f"one of {ROBUST_AGG_CHOICES[1:]}")
+    n = x.shape[-1]
+    if D <= 1:
+        # single device: the "gathered" matrix IS the local stack; the
+        # dense program is already minimal
+        return robust_federated_mean(x, w, kind=kind, trim_frac=trim_frac,
+                                     clip_mult=clip_mult,
+                                     axis_name=axis_name)
+    seg = -(-n // D)
+    xp = jnp.pad(x, ((0, 0), (0, D * seg - n)))
+    # tiled all_to_all: split the (padded) coordinate axis D ways, land
+    # the pieces on the client axis — rows stay in global client order
+    # (source-device-major, the all_gather ordering)
+    xs = lax.all_to_all(xp, axis_name, split_axis=1, concat_axis=0,
+                        tiled=True)                          # [K, seg]
+    K = xs.shape[0]
+    if w is None:
+        wg = jnp.ones((K,), xs.dtype)
+    else:
+        wg = lax.all_gather(w, axis_name, tiled=True)        # [K]
+    nonfinite = jnp.sum((~jnp.isfinite(xs)).astype(xs.dtype), axis=1)
+    finite = lax.psum(nonfinite, axis_name) == 0
+    wg = wg * finite.astype(xs.dtype)
+    act = wg > 0
+    m = jnp.sum(act.astype(xs.dtype))
+    wsum = jnp.sum(wg)
+
+    def _replicate(seg_result):
+        return lax.all_gather(seg_result, axis_name, tiled=True)[:n]
+
+    if kind == "clip":
+        safe = jnp.where(finite[:, None], xs, 0.0)
+        sq = lax.psum(jnp.sum(safe * safe, axis=1), axis_name)
+        nrm = jnp.sqrt(sq)
+        c = clip_mult * _masked_median(nrm, wg)
+        scl = jnp.where(nrm > c, c / jnp.maximum(nrm, 1e-30), 1.0)
+        clipped = jnp.where(act[:, None], wg[:, None] * safe * scl[:, None],
+                            0.0)
+        out = jnp.sum(clipped, axis=0) / jnp.where(wsum > 0, wsum, 1.0)
+        return _replicate(out)
+
+    if kind == "krum":
+        safe = jnp.where(act[:, None], xs, 0.0)
+        g = lax.psum(gram_matrix(safe), axis_name)           # [K, K]
+        sq = jnp.diagonal(g)
+        d2 = jnp.maximum(sq[:, None] + sq[None, :] - 2.0 * g, 0.0)
+        d2 = jnp.where(jnp.eye(K, dtype=bool) | ~act[None, :], jnp.inf, d2)
+        f = jnp.floor(trim_frac * m)
+        n_nb = jnp.maximum(m - f - 2.0, 1.0)
+        posr = jnp.arange(K, dtype=xs.dtype)[None, :]
+        ds = jnp.sort(d2, axis=1)
+        score = jnp.sum(jnp.where(posr < n_nb, ds, 0.0), axis=1)
+        score = jnp.where(act, jnp.minimum(score, 1e30), jnp.inf)
+        idx = jnp.arange(K)
+        better = ((score[None, :] < score[:, None])
+                  | ((score[None, :] == score[:, None])
+                     & (idx[None, :] < idx[:, None])))
+        rank = jnp.sum(better.astype(xs.dtype), axis=1)
+        sel = (rank < jnp.maximum(m - f, 1.0)) & act
+        num = jnp.sum(jnp.where(sel[:, None], wg[:, None] * safe, 0.0),
+                      axis=0)
+        den = jnp.sum(jnp.where(sel, wg, 0.0))
+        return _replicate(num / jnp.where(den > 0, den, 1.0))
+
+    if kind == "geomed":
+        safe = jnp.where(act[:, None], xs, 0.0)
+        v0 = (jnp.sum(safe * wg[:, None], axis=0)
+              / jnp.where(wsum > 0, wsum, 1.0))
+
+        def _weiszfeld(v, _):
+            part = jnp.sum((safe - v[None, :]) ** 2, axis=1)
+            r = jnp.sqrt(lax.psum(part, axis_name))
+            inv = wg / jnp.maximum(r, 1e-8)
+            den = jnp.sum(inv)
+            vn = (jnp.sum(safe * inv[:, None], axis=0)
+                  / jnp.where(den > 0, den, 1.0))
+            return vn, None
+
+        v, _ = lax.scan(_weiszfeld, v0, None, length=GEOMED_ITERS)
+        return _replicate(v)
+
+    # trim/median: identical per-coordinate arithmetic on the segment's
+    # columns — bitwise the dense path for every owned coordinate
+    key = jnp.where(act[:, None], xs, jnp.inf)
+    order = jnp.argsort(key, axis=0)
+    s = jnp.take_along_axis(key, order, axis=0)
+    sw = jnp.take_along_axis(
+        jnp.broadcast_to(wg[:, None], key.shape), order, axis=0)
+    pos = jnp.arange(K, dtype=xs.dtype)[:, None]
+    if kind == "median":
+        lo = jnp.floor((m - 1.0) / 2.0)
+        hi = jnp.floor(m / 2.0)
+        inc = ((pos == lo) | (pos == hi)) & (pos < m)
+    else:                                                    # trim
+        t = jnp.floor(trim_frac * m)
+        inc = (pos >= t) & (pos < m - t)
+    den = jnp.sum(jnp.where(inc, sw, 0.0), axis=0)
+    out = (jnp.sum(jnp.where(inc, sw * s, 0.0), axis=0)
+           / jnp.where(den > 0, den, 1.0))
+    return _replicate(out)
+
+
+def robust_gather_bytes(kind: str, K: int, n: int, D: int,
+                        chunked: bool) -> int:
+    """Per-device bytes of the robust-agg gathered working set — the
+    pure-python byte model behind the bench smoke prediction (the
+    compiled ``memory_analysis`` gate lives in the tests).
+
+    Dense: the ``[K, N]`` f32 all-gathered matrix.  Chunked: the
+    ``[K, ceil(N/D)]`` f32 segment slab (krum's psum'd ``[K, K]`` Gram
+    block rides along — it is what replaces the matrix product over the
+    full rows)."""
+    if kind == "none":
+        return 0
+    if not chunked or D <= 1:
+        return 4 * K * n
+    seg = -(-n // D)
+    extra = 4 * K * K if kind == "krum" else 0
+    return 4 * K * seg + extra
+
+
 def _masked_median(v: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
     """Median of ``v`` [K] over entries with ``w > 0`` (replicated input)."""
     m = jnp.sum(w)
@@ -323,7 +487,8 @@ def _masked_median(v: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
 
 
 def make_robust_mean(kind: str, *, trim_frac: float = 0.1,
-                     clip_mult: float = 3.0, axis_name: str = CLIENT_AXIS):
+                     clip_mult: float = 3.0, axis_name: str = CLIENT_AXIS,
+                     chunked: bool = False, D: int = 1):
     """Factory behind ``--robust-agg`` (choices = ``ROBUST_AGG_CHOICES``).
 
     Returns ``None`` for ``"none"`` (the algorithms then keep their
@@ -332,17 +497,31 @@ def make_robust_mean(kind: str, *, trim_frac: float = 0.1,
     ``mean_fn``.  ``trim_frac`` doubles as krum's assumed attacker
     fraction ``f/m``.  Validated here so a bad flag fails at trainer
     construction, not mid-run inside jit.
+
+    ``chunked=True`` selects :func:`robust_federated_mean_chunked`
+    (``--robust-chunked``): segment-owned estimation that never
+    materializes the ``[K, N]`` gathered matrix; ``D`` is the mesh
+    size, so the engine re-invokes this factory once the mesh exists.
     """
     if kind not in ROBUST_AGG_CHOICES:
         raise ValueError(f"unknown robust aggregation {kind!r}; expected "
                          f"one of {ROBUST_AGG_CHOICES}")
     if kind == "none":
+        if chunked:
+            raise ValueError(
+                "--robust-chunked needs a robust estimator; it re-shapes "
+                "robust aggregation and has no effect on the plain mean "
+                "(use --robust-agg trim/median/clip/krum/geomed)")
         return None
     if not 0.0 <= trim_frac < 0.5:
         raise ValueError(f"trim_frac={trim_frac} must be in [0, 0.5) "
                          "(trimming half or more leaves nothing to average)")
     if clip_mult <= 0.0:
         raise ValueError(f"clip_mult={clip_mult} must be positive")
+    if chunked:
+        return functools.partial(robust_federated_mean_chunked, kind=kind,
+                                 trim_frac=trim_frac, clip_mult=clip_mult,
+                                 D=D, axis_name=axis_name)
     return functools.partial(robust_federated_mean, kind=kind,
                              trim_frac=trim_frac, clip_mult=clip_mult,
                              axis_name=axis_name)

@@ -182,6 +182,17 @@ class FederatedConfig:
     robust_agg: str = "none"       # one of comm.ROBUST_AGG_CHOICES
     trim_frac: float = 0.1
     clip_mult: float = 3.0
+    # chunked robust aggregation (parallel/comm.py
+    # robust_federated_mean_chunked): own the coordinate axis instead of
+    # the client axis — one tiled all_to_all lands a [K, ceil(N/D)]
+    # segment slab per device in place of the all-gathered [K, N]
+    # matrix, the estimator runs on the owned coordinates, and a small
+    # all_gather re-replicates the result.  1/D the peak working set
+    # (gated by compiled memory_analysis in the tests); trim/median are
+    # bitwise the dense estimator, clip/krum/geomed allclose (psum'd
+    # norm/Gram reductions re-associate — PARITY.md).  Requires
+    # --robust-agg != none.  Off by default.
+    robust_chunked: bool = False
 
     # update guards + quarantine (train/engine.py): validate every
     # incoming client delta before aggregation — finite, and norm within
@@ -280,6 +291,22 @@ class FederatedConfig:
     # stay bit-identical on/off.  Off by default; no-op under
     # fused_rounds (one dispatch, nothing to overlap).
     overlap_staging: bool = False
+
+    # whole-round overlap (train/engine.py _predispatch_round): after
+    # round N's comm collective is DISPATCHED (async), pre-dispatch
+    # round N+1's first train epoch before the host blocks on round N's
+    # diagnostics — the device pipeline never drains across the round
+    # boundary, hiding the host's record-build/checkpoint/obs work
+    # behind device execution.  Counter-keyed exactly like
+    # overlap_staging (epoch/key counters advance only when the
+    # pre-dispatched epoch is CONSUMED), so trajectories and
+    # kill/resume stay bit-identical on/off — only dispatch order
+    # changes, never values.  Requested-but-unsafe combinations
+    # (fused_rounds, update_guard, async_rounds, faults/churn,
+    # campaign, population) warn and fall back to the sequential round
+    # loop: each of those reads round N's host-visible outcome before
+    # round N+1's inputs are known.  Off by default.
+    overlap_round: bool = False
 
     # sharded server update (parallel/comm.py sharded_federated_mean,
     # arXiv:2004.13336): compute the consensus aggregate via

@@ -210,13 +210,23 @@ class BlockwiseFederatedTrainer(RoundKernel):
                 "participation < 1 is incompatible with bb_update: the BB "
                 "spectral history (x0/yhat0 deltas) assumes every client "
                 "moves every round (consensus_multi.py:242-278)")
-        if self._pop_active and cfg.overlap_staging:
-            raise ValueError(
-                "overlap_staging is incompatible with population "
-                "sampling: the lookahead stages the NEXT round's batches "
-                "before that round's cohort is drawn, and the staged "
-                "rows would belong to the wrong registry clients")
+        # (overlap_staging x population used to raise here: the lookahead
+        # is now cohort-aware — _prestage_round builds only the
+        # cohort-independent shuffle ahead of time and the cohort
+        # re-index + H2D run at consumption, under the round's actual
+        # cohort — see _epoch_raw/_finish_epoch)
         self.K_local = K // self.D
+        if getattr(cfg, "robust_chunked", False):
+            # chunked robust aggregation needs the mesh size, which the
+            # pre-mesh _init_round_kernel above did not have: rebuild the
+            # estimator segment-owned.  make_robust_mean validates the
+            # robust_agg="none" combination (raises).
+            from federated_pytorch_test_tpu.parallel.comm import (
+                make_robust_mean,
+            )
+            self.mean_fn = make_robust_mean(
+                cfg.robust_agg, trim_frac=cfg.trim_frac,
+                clip_mult=cfg.clip_mult, chunked=True, D=self.D)
 
         # --- common init: all K clients start from identical weights
         # (reference seeds torch.manual_seed(0) before init of EVERY client,
@@ -335,6 +345,44 @@ class BlockwiseFederatedTrainer(RoundKernel):
                 f"fused_rounds requested but unusable: {why}; "
                 "falling back to the per-epoch round loop", stacklevel=2)
             self._use_fused = False
+        # whole-round overlap (cfg.overlap_round): pre-dispatch round
+        # N+1's first train epoch behind round N's comm collective.
+        # Honest gating, same shape as the fused fallback above: every
+        # excluded knob makes round N+1's INPUTS depend on round N's
+        # host-visible outcome (guard verdicts feed quarantine, async/
+        # faults/churn/campaign tick host ledgers, population rotates
+        # the cohort), so a lookahead would dispatch against stale
+        # state.  What remains — participation draws (_round_mask is
+        # stateless in the round coords), BB rho (a device array), the
+        # control plane's round-scope rungs (each targets one of the
+        # subsystems gated off here) — is safe by construction.
+        self._overlap_round = bool(getattr(cfg, "overlap_round", False))
+        self._round_ahead: Optional[tuple] = None
+        if self._overlap_round:
+            why = None
+            if self._use_fused or cfg.fused_rounds:
+                why = ("fused_rounds already runs the whole round as one "
+                       "dispatch — there is no host gap to hide")
+            elif cfg.update_guard:
+                why = ("guard verdicts decide the next round's "
+                       "quarantine set after the comm fetch")
+            elif cfg.async_rounds:
+                why = ("the async scheduler admits updates on the host "
+                       "between rounds")
+            elif self.faults.enabled:
+                why = ("fault/churn families tick host ledgers at every "
+                       "round boundary")
+            elif self.campaign is not None:
+                why = "campaign schedules re-derive the fault spec per round"
+            elif self._pop_active:
+                why = "population sampling rotates the cohort per round"
+            if why is not None:
+                import warnings
+                warnings.warn(
+                    f"overlap_round requested but unsafe: {why}; "
+                    "falling back to the sequential round loop",
+                    stacklevel=2)
+                self._overlap_round = False
 
     # ------------------------------------------------------------------
     # masks / per-block plumbing (hooks overridable by workload subclasses)
@@ -751,6 +799,28 @@ class BlockwiseFederatedTrainer(RoundKernel):
             f"train_epoch[blk={ci}]",
             donate_argnums=self._donate_argnums((0,)))
 
+        if self._overlap_round:
+            # whole-round overlap: the pre-dispatched epoch runs while
+            # the host still reads `state` behind it (checkpoint
+            # snapshot, eval, obs emit) — the lookahead dispatch must
+            # NOT donate.  Same shard body, so the math is identical;
+            # with donation off (the CPU default) the main train_epoch
+            # already satisfies this and is reused as-is.
+            self._fn_cache[("ahead", ci)] = (
+                self._instrument_jit(
+                    shard_map(
+                        epoch_shard,
+                        mesh=self.mesh,
+                        in_specs=(state_specs, spec_c, spec_c, spec_c,
+                                  spec_c, spec_c, spec_c, spec_r, spec_r,
+                                  spec_c),
+                        out_specs=(state_specs, spec_c),
+                        check_vma=False,
+                    ),
+                    f"train_epoch_ahead[blk={ci}]",
+                    donate_argnums=())
+                if self._donate else train_epoch)
+
         comm_out = (state_specs, spec_r, spec_c, spec_r, spec_c,
                     spec_c, spec_r)
         if client_probe:
@@ -1149,22 +1219,20 @@ class BlockwiseFederatedTrainer(RoundKernel):
             keys = stage_global(kd, client_sharding(self.mesh))
             xb, yb = self._dev_gather(keys, *self._dev_x)
             return xb, yb, self._dev_w
+        return self._finish_epoch(self._epoch_raw(c, last))
+
+    def _epoch_raw(self, c: int, last: bool = False):
+        """Cohort-INDEPENDENT host half of epoch ``c``: the seeded
+        shuffle (or its prefetch future) plus next-epoch prefetch
+        bookkeeping.  Split out of ``_build_epoch`` so the overlap
+        lookahead can run it for a population round whose cohort is not
+        drawn yet — ``_finish_epoch`` applies the cohort at
+        consumption."""
         if self._pending is not None and self._pending[0] == c:
             xb, yb, wb = self._pending[1].result()
         else:                        # first epoch / after resume: build now
             xb, yb, wb = self._host_epoch(c)
         self._pending = None
-        if self._pop_active and self._cohort is not None:
-            # population re-index: slot k trains on registry client
-            # cohort[k]'s data shard (rid % K — the K on-disk shards are
-            # shared round-robin across the registered id space, the
-            # standard simulation regime for K ≫ dataset partitions).
-            # Applied at CONSUMPTION, after the counter-keyed prefetch
-            # future resolves, so the prefetch stays cohort-free and a
-            # resumed run re-derives the identical rows from the cohort
-            # it restored.
-            rows = (self._cohort % self.cfg.K).astype(np.int64)
-            xb, yb, wb = xb[rows], yb[rows], wb[rows]
         if self._prefetch_epochs and not last:
             # overlap epoch c+1's permutation/gather with this round's
             # device compute; the counter-keyed seed makes the result
@@ -1174,6 +1242,22 @@ class BlockwiseFederatedTrainer(RoundKernel):
             # dataset-sized result stays pinned until the trainer dies
             self._pending = (c + 1,
                              self._stage_pool.submit(self._host_epoch, c + 1))
+        return xb, yb, wb
+
+    def _finish_epoch(self, raw):
+        """Cohort re-index + H2D staging of a ``_epoch_raw`` result."""
+        xb, yb, wb = raw
+        if self._pop_active and self._cohort is not None:
+            # population re-index: slot k trains on registry client
+            # cohort[k]'s data shard (rid % K — the K on-disk shards are
+            # shared round-robin across the registered id space, the
+            # standard simulation regime for K ≫ dataset partitions).
+            # Applied at CONSUMPTION, after the counter-keyed prefetch
+            # future resolves, so the prefetch (and the overlap
+            # lookahead) stays cohort-free and a resumed run re-derives
+            # the identical rows from the cohort it restored.
+            rows = (self._cohort % self.cfg.K).astype(np.int64)
+            xb, yb, wb = xb[rows], yb[rows], wb[rows]
         sh = client_sharding(self.mesh)
         return (stage_global(xb, sh), stage_global(yb, sh),
                 stage_global(wb, sh))
@@ -1185,10 +1269,13 @@ class BlockwiseFederatedTrainer(RoundKernel):
         self._epochs_staged += 1
         if self._staged_ahead is not None and self._staged_ahead[0] == c:
             # overlap lookahead hit (cfg.overlap_staging): this epoch was
-            # staged while the previous round's comm step executed
-            out = self._staged_ahead[1]
+            # staged while the previous round's comm step executed.
+            # Population lookaheads carry the RAW host arrays (the
+            # cohort was not drawn at prestage time) — finish them now,
+            # under this round's actual cohort.
+            _, payload, needs_finish = self._staged_ahead
             self._staged_ahead = None
-            return out
+            return self._finish_epoch(payload) if needs_finish else payload
         self._staged_ahead = None
         return self._build_epoch(c, last)
 
@@ -1232,11 +1319,77 @@ class BlockwiseFederatedTrainer(RoundKernel):
         # here would serialize the copy against the comm step, which is
         # exactly what --overlap-staging exists to avoid
         t0 = time.perf_counter()  # graftlint: disable=JG104
-        self._staged_ahead = (c, self._build_epoch(c, last=c == total - 1))
+        last = c == total - 1
+        if self._pop_active:
+            # the NEXT round's cohort is not drawn yet — stage the
+            # cohort-independent half (seeded shuffle) and defer the
+            # cohort re-index + H2D copy to consumption (needs_finish)
+            self._staged_ahead = (c, self._epoch_raw(c, last), True)
+        else:
+            self._staged_ahead = (c, self._build_epoch(c, last), False)
         if self._keys_ahead is None:
             ck = self._keys_staged
             self._keys_ahead = (ck, self._build_keys(ck))
         return time.perf_counter() - t0
+
+    def _predispatch_round(self, coords, train_epoch_ahead,
+                           state, z, y, rho, cnorm) -> float:
+        """Round-level overlap (cfg.overlap_round): dispatch the NEXT
+        round's first train epoch while the current comm collective is
+        still executing on-device.  The ahead dispatch reuses the
+        overlap-staging cache (``_prestage_round``), derives the next
+        round's participation mask from the stateless counter-keyed
+        ``_round_mask`` and never donates its inputs — the comm outputs
+        it closes over are only donated by the NEXT comm call, after
+        this dispatch's result has been consumed.  Values are identical
+        to the sequential loop (same fn, same operands); only dispatch
+        ORDER changes, so trajectories stay bitwise and kill/resume is
+        exact (counters advance at consumption, ``_take_round_ahead``).
+        Returns host seconds spent enqueueing, 0.0 when skipped."""
+        cfg = self.cfg
+        total = cfg.Nloop * self.L * cfg.Nadmm * cfg.Nepoch
+        c = self._epochs_staged
+        if c >= total:
+            return 0.0
+        t0 = time.perf_counter()  # graftlint: disable=JG104
+        self._prestage_round()           # no-op if already staged
+        if self._staged_ahead is None or self._keys_ahead is None:
+            return 0.0               # nothing stageable (end of schedule)
+        _, payload, needs_finish = self._staged_ahead
+        if needs_finish:
+            # defensive: raw (cohort-deferred) payloads only exist when
+            # population sampling is active, and population disables
+            # overlap_round at __init__ — but if that gating ever
+            # relaxes, dispatching here under a stale cohort would be
+            # wrong, so leave the staged payload for _stage_epoch (the
+            # consumption path, which finishes under the actual cohort)
+            return 0.0
+        xb, yb, wb = payload
+        ck, keys = self._keys_ahead
+        active = self._round_mask(*coords)
+        out = train_epoch_ahead(state, y, cnorm, keys, xb, yb, wb,
+                                z, rho, active)
+        self._round_ahead = (coords, c, ck, out)
+        return time.perf_counter() - t0
+
+    def _take_round_ahead(self, coords):
+        """Consume a ``_predispatch_round`` result if it matches this
+        round's coords and counters; advances the staging counters (the
+        checkpoint-meta source of truth) exactly as the sequential
+        ``_stage_epoch`` + ``_epoch_keys`` pair would."""
+        ra, self._round_ahead = self._round_ahead, None
+        if ra is None:
+            return None
+        rc, c, ck, out = ra
+        if (rc != coords or c != self._epochs_staged
+                or ck != self._keys_staged):
+            return None              # resume/desync: fall back, recompute
+        self._epochs_staged += 1
+        self._keys_staged += 1
+        self._staged_ahead = None
+        self._keys_ahead = None
+        self._host_dispatches += 1
+        return out
 
     def init_state(self) -> ClientState:
         """A fresh training state — a deep COPY of the staged init, never
@@ -1459,6 +1612,7 @@ class BlockwiseFederatedTrainer(RoundKernel):
         # the counter-keyed seeds rebuild the identical epoch on demand
         self._staged_ahead = None
         self._keys_ahead = None
+        self._round_ahead = None
         self._restore_ledger_meta(meta)
         # a pending prefetched epoch stays valid across restore IF its
         # counter matches (epochs are pure functions of the counter);
@@ -1514,6 +1668,7 @@ class BlockwiseFederatedTrainer(RoundKernel):
         self._pending = None
         self._staged_ahead = None
         self._keys_ahead = None
+        self._round_ahead = None
         self._stage_pool.shutdown(wait=False, cancel_futures=True)
         # drain the async checkpoint writer so an aborted run's LAST
         # submitted round is still durable on disk (the kill/resume
@@ -1707,6 +1862,11 @@ class BlockwiseFederatedTrainer(RoundKernel):
                     # is impossible by construction
                     self._apply_block_control(obs, log)
                 train_epoch, comm_fns, init_opt = self._build_fns(ci)
+                # non-donating twin for the overlap_round pre-dispatch:
+                # its operands (this round's comm outputs) must survive
+                # until the NEXT comm call donates them
+                train_epoch_ahead = self._fn_cache.get(
+                    ("ahead", ci), train_epoch)
                 N = self.block_size(ci)
                 # donated sparse accumulator (top-k only): zeroed [K, N]
                 # buffer the comm step scatters into and hands back
@@ -1792,6 +1952,7 @@ class BlockwiseFederatedTrainer(RoundKernel):
                         cl_nrm = cl_dist = None   # client-ledger probes
                         stage_s = 0.0         # host fetch happens ONCE per round
                         overlap_s = 0.0       # host staging hidden behind comm
+                        overlap_dispatch_s = 0.0   # ahead-epoch enqueue cost
                         phase_marks = []      # (name, cat, t0, t1) span bounds
                         dispatch0 = self._host_dispatches
                         run_fused = (self._use_fused and algo.communicates
@@ -1846,23 +2007,36 @@ class BlockwiseFederatedTrainer(RoundKernel):
                         else:
                             t_train = time.perf_counter()
                             for nepoch in range(cfg.Nepoch):
-                                t_stage = time.perf_counter()
-                                xb, yb, wb = self._stage_epoch(
-                                    last=(nloop == cfg.Nloop - 1
-                                          and ci == self.L - 1
-                                          and nadmm == cfg.Nadmm - 1
-                                          and nepoch == cfg.Nepoch - 1))
-                                keys = self._epoch_keys()
-                                self._obs_sync(obs, xb, yb, wb, keys)
-                                now = time.perf_counter()
-                                stage_s += now - t_stage
-                                if obs.enabled:
-                                    phase_marks.append(
-                                        ("stage", "phase", t_stage, now))
-                                state, losses = train_epoch(
-                                    state, y, cnorm, keys,
-                                    xb, yb, wb, z, rho, active)
-                                self._host_dispatches += 1
+                                ahead = (self._take_round_ahead(
+                                    (nloop, ci, nadmm))
+                                    if nepoch == 0 and self._overlap_round
+                                    else None)
+                                if ahead is not None:
+                                    # epoch 0 was pre-dispatched behind
+                                    # the previous round's collective
+                                    # (cfg.overlap_round) — same fn,
+                                    # same operands, values bitwise; the
+                                    # counters advanced at _take time
+                                    state, losses = ahead
+                                else:
+                                    t_stage = time.perf_counter()
+                                    xb, yb, wb = self._stage_epoch(
+                                        last=(nloop == cfg.Nloop - 1
+                                              and ci == self.L - 1
+                                              and nadmm == cfg.Nadmm - 1
+                                              and nepoch == cfg.Nepoch - 1))
+                                    keys = self._epoch_keys()
+                                    self._obs_sync(obs, xb, yb, wb, keys)
+                                    now = time.perf_counter()
+                                    stage_s += now - t_stage
+                                    if obs.enabled:
+                                        phase_marks.append(
+                                            ("stage", "phase", t_stage,
+                                             now))
+                                    state, losses = train_epoch(
+                                        state, y, cnorm, keys,
+                                        xb, yb, wb, z, rho, active)
+                                    self._host_dispatches += 1
                                 loss_acc = (losses if loss_acc is None
                                             else loss_acc + losses)
                                 if cfg.be_verbose:
@@ -1921,6 +2095,21 @@ class BlockwiseFederatedTrainer(RoundKernel):
                                     cl_nrm, cl_dist = out[-2], out[-1]
                                     out = out[:-2]
                                 state, z, y, rho, x0, yhat0, diag = out
+                                if (self._overlap_round
+                                        and not obs.enabled
+                                        and nadmm + 1 < cfg.Nadmm):
+                                    # dispatch the NEXT round's first
+                                    # epoch before the blocking diag
+                                    # fetch below drains the queue —
+                                    # the collective is still executing.
+                                    # Same-block rounds only: block
+                                    # boundaries rebuild fns/state and
+                                    # may swap compressors (control)
+                                    overlap_dispatch_s += \
+                                        self._predispatch_round(
+                                            (nloop, ci, nadmm + 1),
+                                            train_epoch_ahead,
+                                            state, z, y, rho, cnorm)
                                 diag = {k: float(v)
                                         for k, v in diag.items()}
                                 if cfg.update_guard:
@@ -1946,6 +2135,25 @@ class BlockwiseFederatedTrainer(RoundKernel):
                                 phase_marks.append(
                                     ("comm", "comm", t_comm,
                                      t_comm + comm_s))
+                            if (self._overlap_round and obs.enabled
+                                    and algo.communicates and n_comm > 0
+                                    and nadmm + 1 < cfg.Nadmm):
+                                # with obs recording, the pre-dispatch
+                                # waits until AFTER the comm sync above
+                                # so comm_seconds keeps measuring the
+                                # collective alone (honest attribution);
+                                # the ahead epoch then executes behind
+                                # the loss fetch in the sync phase
+                                t_ov = time.perf_counter()
+                                dt = self._predispatch_round(
+                                    (nloop, ci, nadmm + 1),
+                                    train_epoch_ahead,
+                                    state, z, y, rho, cnorm)
+                                overlap_dispatch_s += dt
+                                if dt > 0:
+                                    phase_marks.append(
+                                        ("overlap_dispatch", "phase",
+                                         t_ov, t_ov + dt))
                         t_sync = time.perf_counter()
                         # single host sync per round: the loss fetch depends on
                         # every epoch in the chain and the diag/rho floats on
@@ -1979,6 +2187,14 @@ class BlockwiseFederatedTrainer(RoundKernel):
                             # dispatch (schema v7) — 0.0 on fused rounds
                             # and whenever the lookahead had nothing to do
                             rec["overlap_seconds"] = overlap_s
+                        if self._overlap_round:
+                            # host seconds spent enqueueing the NEXT
+                            # round's first epoch behind this round's
+                            # collective (schema v14) — 0.0 on the last
+                            # round of a block and whenever the ahead
+                            # cache was already spent
+                            rec["overlap_dispatch_seconds"] = \
+                                overlap_dispatch_s
                         # train-phase dispatches this round: Nepoch on the
                         # per-epoch loop, exactly 1 when fused — the
                         # tentpole's tracked metric

@@ -15,15 +15,36 @@ sys.path.insert(0, REPO)
 import bench  # noqa: E402
 
 
+class _FakeChild:
+    """Stand-in for the precheck/probe ``subprocess.Popen`` child.  A
+    hung child raises TimeoutExpired from ``communicate`` until it is
+    killed — while STAYING diagnosable, exactly the property the real
+    code exploits (``_diagnose_wedge`` reads /proc before the kill).
+    The pid is past the default pid_max so the /proc reads degrade
+    gracefully instead of sampling a real process."""
+
+    def __init__(self, rc=0, hang=False):
+        self.returncode = rc
+        self.pid = 2 ** 22 + 5
+        self._hang = hang
+        self._killed = False
+
+    def communicate(self, timeout=None):
+        if self._hang and not self._killed:
+            raise subprocess.TimeoutExpired(cmd="probe", timeout=timeout)
+        return ("", "")
+
+    def kill(self):
+        self._killed = True
+
+
 class TestAcquireBackend:
     def test_probe_success_touches_nothing(self, monkeypatch):
         calls = []
 
-        class R:
-            returncode = 0
-
-        monkeypatch.setattr(bench.subprocess, "run",
-                            lambda *a, **kw: calls.append(a) or R())
+        monkeypatch.setattr(
+            bench.subprocess, "Popen",
+            lambda *a, **kw: calls.append(a) or _FakeChild(rc=0))
         monkeypatch.delenv("FEDTPU_BENCH_FORCE_CPU", raising=False)
         monkeypatch.delenv("FEDTPU_BENCH_PRECHECK_TIMEOUT_S", raising=False)
         before = os.environ.get("JAX_PLATFORMS")
@@ -40,17 +61,12 @@ class TestAcquireBackend:
         still gets emitted."""
         sleeps, calls = [], []
 
-        class Ok:
-            returncode = 0
-            stderr = ""
-
-        def run(*a, **kw):
+        def popen(*a, **kw):
             calls.append(a)
-            if len(calls) == 1:              # health pre-check passes
-                return Ok()
-            raise subprocess.TimeoutExpired(cmd="probe", timeout=kw["timeout"])
+            # health pre-check passes; every probe child hangs
+            return _FakeChild(rc=0, hang=len(calls) > 1)
 
-        monkeypatch.setattr(bench.subprocess, "run", run)
+        monkeypatch.setattr(bench.subprocess, "Popen", popen)
         monkeypatch.setattr(bench.time, "sleep", sleeps.append)
         monkeypatch.delenv("FEDTPU_BENCH_FORCE_CPU", raising=False)
         monkeypatch.delenv("FEDTPU_BENCH_PRECHECK_TIMEOUT_S", raising=False)
@@ -65,6 +81,9 @@ class TestAcquireBackend:
         assert os.environ["PALLAS_AXON_POOL_IPS"] == ""
         assert bench._RELAY_STATUS["state"] == "unavailable"
         assert bench._RELAY_STATUS["precheck"] == "ok"
+        # the hung probe was snapshot ALIVE: the wedge forensics ride in
+        # the artifact's relay_status
+        assert bench._RELAY_STATUS["diagnosis"]["pid"] == 2 ** 22 + 5
 
     def test_wedged_precheck_short_circuits_to_cpu(self, monkeypatch):
         """The r03-r05 wedge hangs even a bare ``import jax`` subprocess;
@@ -73,10 +92,8 @@ class TestAcquireBackend:
         structured ``wedged`` verdict for the artifact."""
         sleeps = []
 
-        def hang(*a, **kw):
-            raise subprocess.TimeoutExpired(cmd="probe", timeout=kw["timeout"])
-
-        monkeypatch.setattr(bench.subprocess, "run", hang)
+        monkeypatch.setattr(bench.subprocess, "Popen",
+                            lambda *a, **kw: _FakeChild(hang=True))
         monkeypatch.setattr(bench.time, "sleep", sleeps.append)
         monkeypatch.delenv("FEDTPU_BENCH_FORCE_CPU", raising=False)
         monkeypatch.delenv("FEDTPU_BENCH_PRECHECK_TIMEOUT_S", raising=False)
@@ -93,7 +110,7 @@ class TestAcquireBackend:
 
     def test_force_cpu_env_skips_probe(self, monkeypatch):
         monkeypatch.setattr(
-            bench.subprocess, "run",
+            bench.subprocess, "Popen",
             lambda *a, **kw: pytest.fail("probe must not run when forced"))
         monkeypatch.setenv("FEDTPU_BENCH_FORCE_CPU", "1")
         err, used = bench._acquire_backend()
